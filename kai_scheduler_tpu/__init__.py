@@ -1,0 +1,35 @@
+"""kai_scheduler_tpu — a TPU-native batch/gang scheduling framework.
+
+A ground-up rebuild of the capabilities of KAI-Scheduler (reference:
+``/root/reference``, a fork of NVIDIA/KAI-Scheduler) designed for TPU
+hardware: the per-cycle O(jobs x nodes) scheduling math — DRF fair-share
+division, predicate masks, binpack/spread scoring, gang all-or-nothing
+allocation, and reclaim victim search — runs as vmapped / ``lax.scan``
+XLA kernels over a tensorized cluster snapshot, shardable across a
+``jax.sharding.Mesh``.  A host-side framework preserves the reference's
+architecture: actions, plugins, Session, and Statement
+(checkpoint/rollback/commit) transaction semantics.
+
+Layout (mirrors the reference's layer map, SURVEY.md section 1):
+
+- ``apis``       CRD-equivalent dataclasses (Queue, PodGroup, BindRequest,
+                 Topology, SchedulingShard, Config) — ref ``pkg/apis``.
+- ``state``      the tensorized snapshot (``ClusterState`` struct-of-arrays)
+                 plus synthetic cluster generators — ref ``pkg/scheduler/api``
+                 info structs + ``pkg/scheduler/test_utils``.
+- ``ops``        the solver kernels (the "native" compute layer, here XLA):
+                 DRF division, predicates, scoring, gang allocate, victim
+                 search, topology — replaces the reference's Go hot loops.
+- ``parallel``   mesh/sharding helpers (shard the node axis over ICI).
+- ``framework``  Session / Statement / registries / cycle driver — ref
+                 ``pkg/scheduler/framework``.
+- ``actions``    allocate, reclaim, preempt, consolidation,
+                 stalegangeviction — ref ``pkg/scheduler/actions``.
+- ``plugins``    score/mask/order plugins — ref ``pkg/scheduler/plugins``.
+- ``models``     workload-kind groupers (the podgrouper catalog) — ref
+                 ``pkg/podgrouper``.
+- ``binder``     bind execution with backoff/rollback — ref ``pkg/binder``.
+- ``utils``      logging, metrics, priority queues.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,280 @@
+"""CRD-equivalent API objects.
+
+These dataclasses are the framework's "wire protocol" between intake
+(podgrouper / admission), the scheduler core, and the binder — the role
+played in the reference by the CRDs under ``pkg/apis``:
+
+- ``Queue``        ref ``pkg/apis/scheduling/v2/queue_types.go:31-73``
+- ``PodGroup``     ref ``pkg/apis/scheduling/v2alpha2/podgroup_types.go:34-77``
+- ``BindRequest``  ref ``pkg/apis/scheduling/v1alpha2/bindrequest_types.go:12-51``
+- ``Topology``     ref ``pkg/apis/kai/v1alpha1/topology_types.go:53-81``
+- ``SchedulingShard`` ref ``pkg/apis/kai/v1/schedulingshard_types.go:34-64``
+- ``Config``       ref ``pkg/apis/kai/v1/config_types.go``
+
+They are host-side (pure Python) objects; ``state.cluster_state`` flattens
+them into device tensors for the solver kernels.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+#: Resource vector layout used across every tensor in the framework.
+#: Units are chosen so float32 is exact enough at cluster scale:
+#: accelerators in device counts, CPU in cores, memory in GiB.
+RESOURCE_ACCEL = 0  #: accelerator devices (TPU chips; "GPU" in the reference)
+RESOURCE_CPU = 1    #: CPU cores (float)
+RESOURCE_MEM = 2    #: memory, GiB (float)
+NUM_RESOURCES = 3
+RESOURCE_NAMES = ("accel", "cpu", "memory")
+
+#: Sentinel meaning "no limit" — ref ``commonconstants.UnlimitedResourceQuantity``.
+UNLIMITED = -1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceVec:
+    """A (accel, cpu, mem) triple — ref ``api/resource_info/resource_info.go:34-37``."""
+
+    accel: float = 0.0
+    cpu: float = 0.0
+    memory: float = 0.0
+
+    def as_tuple(self) -> tuple[float, float, float]:
+        return (self.accel, self.cpu, self.memory)
+
+    def __add__(self, other: "ResourceVec") -> "ResourceVec":
+        return ResourceVec(self.accel + other.accel, self.cpu + other.cpu,
+                           self.memory + other.memory)
+
+
+# ---------------------------------------------------------------------------
+# Queue (ref pkg/apis/scheduling/v2/queue_types.go)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class QueueResource:
+    """Per-resource queue knobs — quota / overQuotaWeight / limit.
+
+    Ref ``queue_types.go`` ``QueueResource{Quota,OverQuotaWeight,Limit}``.
+    ``quota`` is the deserved (guaranteed) amount; ``limit`` the hard cap
+    (``UNLIMITED`` for none); ``over_quota_weight`` the share weight for
+    dividing surplus.
+    """
+
+    quota: float = 0.0
+    over_quota_weight: float = 1.0
+    limit: float = UNLIMITED
+
+
+@dataclasses.dataclass
+class Queue:
+    """A scheduling queue; 2+-level hierarchy via ``parent``.
+
+    Ref ``pkg/apis/scheduling/v2/queue_types.go:31-73``.
+    """
+
+    name: str
+    parent: str | None = None
+    priority: int = 0
+    accel: QueueResource = dataclasses.field(default_factory=QueueResource)
+    cpu: QueueResource = dataclasses.field(default_factory=QueueResource)
+    memory: QueueResource = dataclasses.field(default_factory=QueueResource)
+    #: minimum runtime before a job in this queue may be preempted / reclaimed
+    #: (seconds) — ref queue_types.go ``PreemptMinRuntime``/``ReclaimMinRuntime``.
+    preempt_min_runtime: float = 0.0
+    reclaim_min_runtime: float = 0.0
+    creation_timestamp: float = 0.0
+
+    def resource(self, r: int) -> QueueResource:
+        return (self.accel, self.cpu, self.memory)[r]
+
+
+# ---------------------------------------------------------------------------
+# Pods & PodGroups (ref pkg/apis/scheduling/v2alpha2/podgroup_types.go)
+# ---------------------------------------------------------------------------
+
+class PodStatus(enum.IntEnum):
+    """Lifecycle of a task, reduced to what the scheduler needs.
+
+    Ref ``pkg/scheduler/api/pod_status`` (Pending/Bound/Running/Releasing...).
+    """
+
+    PENDING = 0
+    BOUND = 1      # scheduled this cycle or earlier, pod not yet running
+    RUNNING = 2
+    RELEASING = 3  # terminating; resources count as "releasing"
+    SUCCEEDED = 4
+    FAILED = 5
+
+
+@dataclasses.dataclass
+class Pod:
+    """One task of a pod group — ref ``api/pod_info/pod_info.go:68-106``."""
+
+    name: str
+    group: str
+    resources: ResourceVec = dataclasses.field(default_factory=ResourceVec)
+    priority: int = 0
+    status: PodStatus = PodStatus.PENDING
+    node: str | None = None              # set when bound/running
+    subgroup: str | None = None          # hierarchical gang subgroup name
+    #: fraction of one accelerator requested (GPU-sharing); 0 => whole devices
+    #: ref api/resource_info/gpu_resource_requirment.go portion
+    accel_portion: float = 0.0
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    creation_timestamp: float = 0.0
+
+
+class Preemptibility(str, enum.Enum):
+    """Ref podgroup_types.go ``Preemptibility``."""
+
+    PREEMPTIBLE = "Preemptible"
+    NON_PREEMPTIBLE = "NonPreemptible"
+
+
+@dataclasses.dataclass
+class TopologyConstraint:
+    """Gang placement constraint against a Topology tree.
+
+    Ref ``podgroup_types.go:366-381`` — ``Required`` level: every pod of the
+    gang must land inside one domain at that level; ``Preferred``: best-effort
+    locality at that level.
+    """
+
+    topology: str | None = None
+    required_level: str | None = None
+    preferred_level: str | None = None
+
+
+@dataclasses.dataclass
+class SubGroup:
+    """Hierarchical gang subgroup — ref podgroup_types.go ``SubGroups``."""
+
+    name: str
+    min_member: int = 0
+    parent: str | None = None
+    topology_constraint: TopologyConstraint | None = None
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """The gang unit — ref ``podgroup_types.go:34-77``."""
+
+    name: str
+    queue: str
+    min_member: int = 1
+    priority: int = 0
+    preemptibility: Preemptibility = Preemptibility.PREEMPTIBLE
+    topology_constraint: TopologyConstraint | None = None
+    sub_groups: list[SubGroup] = dataclasses.field(default_factory=list)
+    #: backoff: number of scheduling cycles to skip after repeated failure —
+    #: ref podgroup_types.go ``SchedulingBackoff``.
+    scheduling_backoff: int = 0
+    creation_timestamp: float = 0.0
+    #: wall-clock the gang became running (for minruntime protection)
+    last_start_timestamp: float | None = None
+
+
+# ---------------------------------------------------------------------------
+# Nodes & Topology (ref pkg/apis/kai/v1alpha1/topology_types.go)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Node:
+    """Schedulable machine — ref ``api/node_info/node_info.go:68-96``."""
+
+    name: str
+    allocatable: ResourceVec = dataclasses.field(default_factory=ResourceVec)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: accelerator memory per device, GiB (for memory-based sharing)
+    accel_memory_gib: float = 16.0
+    unschedulable: bool = False
+
+
+@dataclasses.dataclass
+class Topology:
+    """Ordered physical levels, outermost first — ref topology_types.go:53-81.
+
+    ``levels`` holds node-label keys, e.g. ["cloud.provider.com/block",
+    "cloud.provider.com/rack", "kubernetes.io/hostname"].
+    """
+
+    name: str
+    levels: list[str] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# BindRequest (ref pkg/apis/scheduling/v1alpha2/bindrequest_types.go)
+# ---------------------------------------------------------------------------
+
+class ReceivedResourceType(str, enum.Enum):
+    REGULAR = "Regular"
+    FRACTION = "Fraction"
+
+
+@dataclasses.dataclass
+class BindRequest:
+    """The scheduler->binder contract — ref bindrequest_types.go:12-51."""
+
+    pod_name: str
+    selected_node: str
+    received_resource_type: ReceivedResourceType = ReceivedResourceType.REGULAR
+    received_accel_count: int = 0
+    received_accel_portion: float = 0.0
+    selected_accel_groups: list[str] = dataclasses.field(default_factory=list)
+    backoff_limit: int = 3
+    #: filled by the binder
+    phase: str = "Pending"   # Pending | Succeeded | Failed
+    failures: int = 0
+
+
+@dataclasses.dataclass
+class Eviction:
+    """A victim eviction decision emitted by reclaim/preempt/consolidation."""
+
+    pod_name: str
+    group: str
+    reason: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Operator-level config CRDs (ref pkg/apis/kai/v1)
+# ---------------------------------------------------------------------------
+
+class PlacementStrategy(str, enum.Enum):
+    """binpack vs spread — ref schedulingshard_types.go ``PlacementStrategy``."""
+
+    BINPACK = "binpack"
+    SPREAD = "spread"
+
+
+@dataclasses.dataclass
+class SchedulingShard:
+    """One scheduler instance over a node-pool partition.
+
+    Ref ``pkg/apis/kai/v1/schedulingshard_types.go:34-64``.
+    """
+
+    name: str = "default"
+    partition_label_value: str | None = None
+    placement_strategy_accel: PlacementStrategy = PlacementStrategy.BINPACK
+    placement_strategy_cpu: PlacementStrategy = PlacementStrategy.BINPACK
+    queue_depth_per_action: dict[str, int] = dataclasses.field(default_factory=dict)
+    k_value: float = 1.0
+    args: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Config:
+    """Operator-level global configuration — ref config_types.go."""
+
+    schedule_period_s: float = 1.0
+    stale_gang_grace_s: float = 60.0
+    default_scheduler_name: str = "kai-scheduler-tpu"
+    shards: list[SchedulingShard] = dataclasses.field(default_factory=list)

@@ -1,0 +1,10 @@
+from .cluster_state import (  # noqa: F401
+    ClusterState,
+    GangState,
+    NodeState,
+    QueueState,
+    RunningState,
+    SnapshotIndex,
+    build_snapshot,
+)
+from .synthetic import make_cluster  # noqa: F401

@@ -1,0 +1,453 @@
+"""The tensorized cluster snapshot.
+
+The reference scheduler materializes an object-graph snapshot each cycle
+(``pkg/scheduler/cache/cluster_info/cluster_info.go:119`` building
+``api.ClusterInfo`` out of NodeInfo / PodInfo / PodGroupInfo / QueueInfo,
+SURVEY.md section 2.6).  The TPU-native design replaces that object graph
+with a **struct-of-arrays pytree** so every per-cycle decision — fairness
+division, predicate masks, scoring, gang allocation, victim search — is a
+tensor op over static shapes:
+
+- node axis  ``N``  (padded)            — ref NodeInfo
+- queue axis ``Q``  (padded, 2+ levels) — ref QueueInfo
+- gang axis  ``G``  (padded PodGroups)  — ref PodGroupInfo
+- task axis  ``T``  (pending tasks per gang, padded) — ref tasksToAllocate
+- running-pod axis ``M`` (bound/running pods, victims) — ref PodInfo
+- resource axis ``R = 3`` (accel devices, cpu cores, mem GiB)
+- selector-key axis ``K`` (label vocabulary for nodeSelector matching)
+- topology-level axis ``L`` (domain id per physical level)
+
+All arrays are fixed-shape so one XLA compilation serves every cycle;
+capacity growth only triggers recompiles at padded-size boundaries.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..apis import types as apis
+
+UNLIMITED = apis.UNLIMITED
+R = apis.NUM_RESOURCES
+
+
+class NodeState(struct.PyTreeNode):
+    """Per-node accounting — ref ``api/node_info/node_info.go:68-96``.
+
+    ``free`` mirrors NodeInfo.Idle; ``releasing`` the resources of
+    terminating pods (allocatable-but-not-yet); ``allocatable`` the total.
+    """
+
+    allocatable: jax.Array   # f32 [N, R]
+    free: jax.Array          # f32 [N, R]
+    releasing: jax.Array     # f32 [N, R]
+    valid: jax.Array         # bool [N]
+    labels: jax.Array        # i32 [N, K]   value-id per selector key, -1 = unset
+    topology: jax.Array      # i32 [N, L]   domain id per level, innermost = hostname
+
+    @property
+    def n(self) -> int:
+        return self.valid.shape[0]
+
+
+class QueueState(struct.PyTreeNode):
+    """Queue hierarchy + resource shares.
+
+    Ref ``api/queue_info/queue_info.go:32-43`` and the proportion plugin's
+    ``resource_share.ResourceShare`` (Deserved / FairShare / MaxAllowed /
+    OverQuotaWeight / Allocated / Request / Usage).
+    """
+
+    parent: jax.Array        # i32 [Q]  index of parent queue, -1 = top level
+    depth: jax.Array         # i32 [Q]  0 = top level
+    priority: jax.Array      # i32 [Q]
+    quota: jax.Array         # f32 [Q, R]  deserved; UNLIMITED sentinel allowed
+    over_quota_weight: jax.Array  # f32 [Q, R]
+    limit: jax.Array         # f32 [Q, R]  maxAllowed; UNLIMITED sentinel
+    allocated: jax.Array     # f32 [Q, R]  currently allocated to running pods
+    allocated_nonpreemptible: jax.Array  # f32 [Q, R]
+    request: jax.Array       # f32 [Q, R]  allocated + pending requests
+    usage: jax.Array         # f32 [Q, R]  normalized historical usage (usagedb)
+    fair_share: jax.Array    # f32 [Q, R]  output of the DRF division kernel
+    valid: jax.Array         # bool [Q]
+    creation_order: jax.Array  # i32 [Q]  tie-break (older first)
+
+    @property
+    def q(self) -> int:
+        return self.valid.shape[0]
+
+
+class GangState(struct.PyTreeNode):
+    """Pending pod groups with padded task tables.
+
+    Ref ``api/podgroup_info/job_info.go:65-99`` (PodGroupInfo) and
+    ``api/podgroup_info/allocation_info.go:27`` (GetTasksToAllocate).
+    Tasks are pre-sorted host-side by the task-order plugin semantics
+    (priority desc, creation asc) so the allocation kernel can use
+    stop-at-first-failure prefix semantics.
+    """
+
+    queue: jax.Array         # i32 [G]  queue index
+    min_member: jax.Array    # i32 [G]
+    priority: jax.Array      # i32 [G]
+    preemptible: jax.Array   # bool [G]
+    valid: jax.Array         # bool [G]
+    creation_order: jax.Array  # i32 [G]  tie-break (older first)
+    backoff: jax.Array       # i32 [G]  cycles to skip (SchedulingBackoff)
+    task_req: jax.Array      # f32 [G, T, R]
+    task_valid: jax.Array    # bool [G, T]
+    task_selector: jax.Array  # i32 [G, T, K]  required node-label value-id, -1 = any
+    task_portion: jax.Array  # f32 [G, T]  fractional accel request (0 = whole)
+    required_level: jax.Array   # i32 [G]  topology level index, -1 = none
+    preferred_level: jax.Array  # i32 [G]  topology level index, -1 = none
+
+    @property
+    def g(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def t(self) -> int:
+        return self.task_valid.shape[1]
+
+
+class RunningState(struct.PyTreeNode):
+    """Bound/running pods — the victim candidates for reclaim / preempt /
+    consolidation.  Ref PodInfo with status in {Bound, Running, Releasing}.
+    """
+
+    req: jax.Array           # f32 [M, R]
+    node: jax.Array          # i32 [M]  node index, -1 invalid
+    queue: jax.Array         # i32 [M]
+    gang: jax.Array          # i32 [M]  owning pod-group id (host-side table)
+    priority: jax.Array      # i32 [M]
+    preemptible: jax.Array   # bool [M]
+    valid: jax.Array         # bool [M]
+    #: pod is terminating — occupies resources but is not a victim candidate
+    releasing: jax.Array     # bool [M]
+    #: seconds since the owning gang started (for minruntime filters)
+    runtime_s: jax.Array     # f32 [M]
+
+    @property
+    def m(self) -> int:
+        return self.valid.shape[0]
+
+
+class ClusterState(struct.PyTreeNode):
+    """The full per-cycle snapshot handed to the solver kernels."""
+
+    nodes: NodeState
+    queues: QueueState
+    gangs: GangState
+    running: RunningState
+
+    @property
+    def total_capacity(self) -> jax.Array:
+        """Cluster-wide allocatable per resource, f32 [R]."""
+        return jnp.sum(
+            jnp.where(self.nodes.valid[:, None], self.nodes.allocatable, 0.0),
+            axis=0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Padding helpers
+# ---------------------------------------------------------------------------
+
+def _round_up(n: int, multiple: int = 8) -> int:
+    """Pad sizes to multiples so capacity growth rarely recompiles."""
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+# ---------------------------------------------------------------------------
+# Snapshot builder (host): api objects -> ClusterState
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SnapshotIndex:
+    """Host-side name<->index maps produced alongside a ClusterState so the
+    commit path can translate placement tensors back into BindRequests.
+    """
+
+    node_names: list[str]
+    queue_names: list[str]
+    gang_names: list[str]
+    #: task pod names per gang slot, [G][T]
+    task_names: list[list[str | None]]
+    running_pod_names: list[str]
+    selector_keys: list[str]
+    label_vocab: dict[tuple[str, str], int]
+    topology_levels: list[str]
+
+    def node_index(self, name: str) -> int:
+        return self.node_names.index(name)
+
+
+def build_snapshot(
+    nodes: list[apis.Node],
+    queues: list[apis.Queue],
+    pod_groups: list[apis.PodGroup],
+    pods: list[apis.Pod],
+    topology: apis.Topology | None = None,
+    *,
+    max_tasks_per_gang: int | None = None,
+    pad: int = 8,
+    dtype=jnp.float32,
+    now: float | None = None,
+) -> tuple[ClusterState, SnapshotIndex]:
+    """Flatten API objects into a ClusterState (+ index for the commit path).
+
+    This is the TPU-native analogue of the reference's snapshot step
+    (``cache/cluster_info/cluster_info.go:229`` snapshotNodes,
+    ``:346`` snapshotPodGroups).
+    """
+    # --- vocabularies -----------------------------------------------------
+    selector_keys: list[str] = []
+    for pod in pods:
+        for k in pod.node_selector:
+            if k not in selector_keys:
+                selector_keys.append(k)
+    label_vocab: dict[tuple[str, str], int] = {}
+
+    def value_id(key: str, value: str) -> int:
+        return label_vocab.setdefault((key, value), len(label_vocab))
+
+    topo_levels = list(topology.levels) if topology else []
+    L = max(1, len(topo_levels))
+    K = max(1, len(selector_keys))
+
+    # --- nodes ------------------------------------------------------------
+    live_nodes = [n for n in nodes if not n.unschedulable]
+    N = _round_up(len(live_nodes), pad)
+    node_alloc = np.zeros((N, R), np.float32)
+    node_labels = np.full((N, K), -1, np.int32)
+    node_topo = np.full((N, L), -1, np.int32)
+    node_valid = np.zeros((N,), bool)
+    node_names = [n.name for n in live_nodes]
+    domain_vocab: dict[tuple[int, str], int] = {}
+    for i, n in enumerate(live_nodes):
+        node_alloc[i] = n.allocatable.as_tuple()
+        node_valid[i] = True
+        for ki, key in enumerate(selector_keys):
+            if key in n.labels:
+                node_labels[i, ki] = value_id(key, n.labels[key])
+        # Topology domains: id per level = dense index of the label-path
+        # prefix at that level, so equal ids <=> same physical domain
+        # (ref plugins/topology/topology_structs.go DomainID = joined path).
+        path: list[str] = []
+        for li, level_key in enumerate(topo_levels):
+            val = n.labels.get(level_key)
+            if val is None:
+                break
+            path.append(val)
+            node_topo[i, li] = domain_vocab.setdefault((li, "/".join(path)), len(domain_vocab))
+
+    # --- queues (parents before children) --------------------------------
+    queue_names = [q.name for q in queues]
+    q_index = {name: i for i, name in enumerate(queue_names)}
+    Q = _round_up(len(queues), pad)
+    q_parent = np.full((Q,), -1, np.int32)
+    q_depth = np.zeros((Q,), np.int32)
+    q_priority = np.zeros((Q,), np.int32)
+    q_quota = np.zeros((Q, R), np.float32)
+    q_oqw = np.zeros((Q, R), np.float32)
+    q_limit = np.full((Q, R), UNLIMITED, np.float32)
+    q_valid = np.zeros((Q,), bool)
+    q_creation = np.zeros((Q,), np.int32)
+    for i, q in enumerate(queues):
+        q_valid[i] = True
+        q_priority[i] = q.priority
+        q_creation[i] = i
+        if q.parent is not None:
+            q_parent[i] = q_index[q.parent]
+        for r in range(R):
+            qr = q.resource(r)
+            q_quota[i, r] = qr.quota
+            q_oqw[i, r] = qr.over_quota_weight
+            q_limit[i, r] = qr.limit
+    # depth by chasing parents (hierarchy is shallow; bounded loop)
+    for i in range(len(queues)):
+        d, p = 0, int(q_parent[i])
+        while p >= 0:
+            d, p = d + 1, int(q_parent[p])
+        q_depth[i] = d
+
+    # --- pod groups + tasks ----------------------------------------------
+    group_names = [g.name for g in pod_groups]
+    g_index = {name: i for i, name in enumerate(group_names)}
+    pending_by_group: dict[str, list[apis.Pod]] = {g.name: [] for g in pod_groups}
+    running_pods: list[apis.Pod] = []
+    for pod in pods:
+        if pod.status == apis.PodStatus.PENDING:
+            if pod.group in pending_by_group:
+                pending_by_group[pod.group].append(pod)
+        elif pod.status in (apis.PodStatus.BOUND, apis.PodStatus.RUNNING,
+                            apis.PodStatus.RELEASING):
+            running_pods.append(pod)
+
+    max_pending = max([len(v) for v in pending_by_group.values()] + [1])
+    T = max_tasks_per_gang or max_pending
+    if T < max_pending:
+        raise ValueError(
+            f"max_tasks_per_gang={T} < largest gang ({max_pending} pending "
+            "tasks); truncating would starve gangs whose min_member exceeds "
+            "the cap")
+    T = _round_up(T, 4)
+    G = _round_up(len(pod_groups), pad)
+    gk = dict(
+        queue=np.zeros((G,), np.int32),
+        min_member=np.zeros((G,), np.int32),
+        priority=np.zeros((G,), np.int32),
+        preemptible=np.zeros((G,), bool),
+        valid=np.zeros((G,), bool),
+        creation_order=np.zeros((G,), np.int32),
+        backoff=np.zeros((G,), np.int32),
+        task_req=np.zeros((G, T, R), np.float32),
+        task_valid=np.zeros((G, T), bool),
+        task_selector=np.full((G, T, K), -1, np.int32),
+        task_portion=np.zeros((G, T), np.float32),
+        required_level=np.full((G,), -1, np.int32),
+        preferred_level=np.full((G,), -1, np.int32),
+    )
+    task_names: list[list[str | None]] = [[None] * T for _ in range(G)]
+    for i, g in enumerate(pod_groups):
+        tasks = pending_by_group[g.name]
+        # task-order plugin semantics: priority desc, then creation asc
+        tasks.sort(key=lambda p: (-p.priority, p.creation_timestamp, p.name))
+        gk["queue"][i] = q_index.get(g.queue, 0)
+        gk["min_member"][i] = g.min_member
+        gk["priority"][i] = g.priority
+        gk["preemptible"][i] = g.preemptibility == apis.Preemptibility.PREEMPTIBLE
+        gk["valid"][i] = bool(tasks)
+        gk["creation_order"][i] = i
+        gk["backoff"][i] = g.scheduling_backoff
+        tc = g.topology_constraint
+        if tc is not None and topology is not None:
+            if tc.required_level in topo_levels:
+                gk["required_level"][i] = topo_levels.index(tc.required_level)
+            if tc.preferred_level in topo_levels:
+                gk["preferred_level"][i] = topo_levels.index(tc.preferred_level)
+        for t, pod in enumerate(tasks[:T]):
+            gk["task_req"][i, t] = pod.resources.as_tuple()
+            gk["task_valid"][i, t] = True
+            gk["task_portion"][i, t] = pod.accel_portion
+            task_names[i][t] = pod.name
+            for ki, key in enumerate(selector_keys):
+                if key in pod.node_selector:
+                    gk["task_selector"][i, t, ki] = value_id(key, pod.node_selector[key])
+
+    # --- running pods -----------------------------------------------------
+    # Pods whose node is missing from the snapshot (cordoned/deleted) keep
+    # valid=True with node=-1: they still count toward queue allocation so
+    # DRF fairness stays honest, but victim kernels skip node<0 rows.
+    M = _round_up(len(running_pods), pad)
+    node_idx = {name: i for i, name in enumerate(node_names)}
+    rk = dict(
+        req=np.zeros((M, R), np.float32),
+        node=np.full((M,), -1, np.int32),
+        queue=np.zeros((M,), np.int32),
+        gang=np.full((M,), -1, np.int32),
+        priority=np.zeros((M,), np.int32),
+        preemptible=np.zeros((M,), bool),
+        valid=np.zeros((M,), bool),
+        releasing=np.zeros((M,), bool),
+        runtime_s=np.zeros((M,), np.float32),
+    )
+    running_names: list[str] = [""] * M
+    if now is None:
+        now = max([p.creation_timestamp for p in pods], default=0.0)
+    for j, pod in enumerate(running_pods):
+        grp = g_index.get(pod.group, -1)
+        rk["req"][j] = pod.resources.as_tuple()
+        rk["node"][j] = node_idx.get(pod.node, -1)
+        rk["gang"][j] = grp
+        if grp >= 0:
+            pg = pod_groups[grp]
+            rk["queue"][j] = q_index.get(pg.queue, 0)
+            rk["priority"][j] = pg.priority
+            rk["preemptible"][j] = pg.preemptibility == apis.Preemptibility.PREEMPTIBLE
+            started = pg.last_start_timestamp
+            rk["runtime_s"][j] = max(0.0, now - started) if started is not None else 0.0
+        rk["valid"][j] = True
+        rk["releasing"][j] = pod.status == apis.PodStatus.RELEASING
+        running_names[j] = pod.name
+
+    # --- derived node free / releasing -----------------------------------
+    node_used = np.zeros((N, R), np.float32)
+    node_rel = np.zeros((N, R), np.float32)
+    for j, pod in enumerate(running_pods):
+        ni = rk["node"][j]
+        if ni < 0:
+            continue  # unknown node: counts for queues, not for node capacity
+        if pod.status == apis.PodStatus.RELEASING:
+            node_rel[ni] += rk["req"][j]
+        else:
+            node_used[ni] += rk["req"][j]
+    node_free = np.maximum(node_alloc - node_used - node_rel, 0.0)
+
+    # --- derived queue allocated / request (host mirror of
+    #     queuecontroller status; kernels recompute on-device when needed) --
+    q_alloc = np.zeros((Q, R), np.float32)
+    q_alloc_np = np.zeros((Q, R), np.float32)
+    q_request = np.zeros((Q, R), np.float32)
+    for j in range(len(running_pods)):
+        if rk["valid"][j]:
+            qi = rk["queue"][j]
+            q_alloc[qi] += rk["req"][j]
+            q_request[qi] += rk["req"][j]
+            if not rk["preemptible"][j]:
+                q_alloc_np[qi] += rk["req"][j]
+    for i in range(len(pod_groups)):
+        if gk["valid"][i]:
+            qi = gk["queue"][i]
+            q_request[qi] += gk["task_req"][i][gk["task_valid"][i]].sum(axis=0)
+    # propagate to parents (requests/allocations roll up the hierarchy)
+    for arr in (q_alloc, q_alloc_np, q_request):
+        for i in sorted(range(len(queues)), key=lambda i: -q_depth[i]):
+            p = q_parent[i]
+            if p >= 0:
+                arr[p] += arr[i]
+
+    state = ClusterState(
+        nodes=NodeState(
+            allocatable=jnp.asarray(node_alloc, dtype),
+            free=jnp.asarray(node_free, dtype),
+            releasing=jnp.asarray(node_rel, dtype),
+            valid=jnp.asarray(node_valid),
+            labels=jnp.asarray(node_labels),
+            topology=jnp.asarray(node_topo),
+        ),
+        queues=QueueState(
+            parent=jnp.asarray(q_parent),
+            depth=jnp.asarray(q_depth),
+            priority=jnp.asarray(q_priority),
+            quota=jnp.asarray(q_quota, dtype),
+            over_quota_weight=jnp.asarray(q_oqw, dtype),
+            limit=jnp.asarray(q_limit, dtype),
+            allocated=jnp.asarray(q_alloc, dtype),
+            allocated_nonpreemptible=jnp.asarray(q_alloc_np, dtype),
+            request=jnp.asarray(q_request, dtype),
+            usage=jnp.zeros((Q, R), dtype),
+            fair_share=jnp.zeros((Q, R), dtype),
+            valid=jnp.asarray(q_valid),
+            creation_order=jnp.asarray(q_creation),
+        ),
+        gangs=GangState(**{k: jnp.asarray(v) for k, v in gk.items()}),
+        running=RunningState(**{k: jnp.asarray(v) for k, v in rk.items()}),
+    )
+    index = SnapshotIndex(
+        node_names=node_names,
+        queue_names=queue_names,
+        gang_names=group_names,
+        task_names=task_names,
+        running_pod_names=running_names,
+        selector_keys=selector_keys,
+        label_vocab=label_vocab,
+        topology_levels=topo_levels,
+    )
+    return state, index

@@ -1,0 +1,66 @@
+"""Snapshot-builder tests — analogue of the reference's cluster_info tests
+(``pkg/scheduler/cache/cluster_info/cluster_info_test.go``)."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.state import build_snapshot, make_cluster
+
+
+def test_build_snapshot_shapes_and_padding():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=10, num_gangs=5, tasks_per_gang=3)
+    state, index = build_snapshot(nodes, queues, groups, pods, topo)
+    assert state.nodes.valid.shape[0] >= 10
+    assert int(state.nodes.valid.sum()) == 10
+    assert int(state.gangs.valid.sum()) == 5
+    assert int(state.gangs.task_valid.sum()) == 15
+    assert len(index.node_names) == 10
+
+
+def test_total_capacity_ignores_padding():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, node_accel=8.0, node_cpu=32.0, node_mem=128.0)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    cap = np.asarray(state.total_capacity)
+    np.testing.assert_allclose(cap, [32.0, 128.0, 512.0])
+
+
+def test_running_pods_reduce_free_and_fill_queue_allocated():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, num_gangs=4, tasks_per_gang=2, running_fraction=0.5,
+        task_accel=1.0)
+    state, index = build_snapshot(nodes, queues, groups, pods, topo)
+    assert int(state.running.valid.sum()) == 4  # 2 gangs x 2 tasks
+    free = np.asarray(state.nodes.free)
+    alloc = np.asarray(state.nodes.allocatable)
+    assert (free <= alloc).all()
+    # total allocated accel across queues at leaf level == 4 devices
+    q = state.queues
+    leaf = (np.asarray(q.depth) == 1) & np.asarray(q.valid)
+    assert np.asarray(q.allocated)[leaf, apis.RESOURCE_ACCEL].sum() == 4.0
+    # and the department level rolls up the same total
+    top = (np.asarray(q.depth) == 0) & np.asarray(q.valid)
+    assert np.asarray(q.allocated)[top, apis.RESOURCE_ACCEL].sum() == 4.0
+
+
+def test_queue_request_includes_pending():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=2, num_gangs=2, tasks_per_gang=2, task_accel=1.0)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    q = state.queues
+    top = (np.asarray(q.depth) == 0) & np.asarray(q.valid)
+    assert np.asarray(q.request)[top, apis.RESOURCE_ACCEL].sum() == 4.0
+
+
+def test_topology_domains_nest():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=16, topology_levels=(2, 2))
+    state, index = build_snapshot(nodes, queues, groups, pods, topo)
+    t = np.asarray(state.nodes.topology)[:16]
+    # level 0 has 2 domains, level 1 has 4 distinct domains
+    assert len(np.unique(t[:, 0])) == 2
+    assert len(np.unique(t[:, 1])) == 4
+    # nodes sharing a level-1 domain must share the level-0 domain
+    for d in np.unique(t[:, 1]):
+        rows = t[t[:, 1] == d]
+        assert len(np.unique(rows[:, 0])) == 1
