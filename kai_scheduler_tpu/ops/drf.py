@@ -1,0 +1,186 @@
+"""Hierarchical DRF fair-share division — the proportion plugin's core math.
+
+TPU-native rebuild of the reference algorithm in
+``pkg/scheduler/plugins/proportion/resource_division/resource_division.go``
+(see also ``docs/fairness/README.md:43-60``):
+
+1. **Deserved pass** — every queue gets ``min(deserved, requestable)``.
+2. **Over-quota pass** — the surplus is divided among still-unsatisfied
+   queues, highest priority tier first; within a tier an iterative
+   water-fill hands each queue ``remaining * shareWeight_i / sum(shareWeight)``
+   where ``shareWeight = max(0, w + k*(w - usage))`` (w = normalized
+   over-quota weight, usage = normalized historical usage — the
+   time-based-fairshare hook).  Unsatisfied queues are floored to whole
+   units per round ("round numbers" rule in the reference).
+3. **Remainder pass** — leftover whole units go one per queue, ordered by
+   priority, then largest fractional remainder, then creation order
+   (ref ``divideRemainingResource`` + ``remainingRequestedOrderFn``).
+
+The reference runs this per resource with Go maps and heaps; here every
+pass is a masked segment-reduction over the queue axis, so all sibling
+groups (segments keyed by parent queue) and all resources (via ``vmap``)
+divide concurrently.  Hierarchy is handled level-by-level: a parent's
+fair share becomes the "total" for dividing among its children.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..apis.types import UNLIMITED
+from ..state.cluster_state import ClusterState, QueueState
+
+_NEG_INF = jnp.iinfo(jnp.int32).min
+
+
+def _segment_sum(values: jax.Array, seg: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_sum(values, seg, num_segments=num_segments)
+
+
+def _divide_one_resource(
+    seg_total: jax.Array,      # f32 [S]   total amount per sibling segment
+    quota: jax.Array,          # f32 [Q]   deserved; UNLIMITED => segment total
+    weight: jax.Array,         # f32 [Q]   over-quota weight
+    limit: jax.Array,          # f32 [Q]   maxAllowed; UNLIMITED => none
+    request: jax.Array,        # f32 [Q]
+    usage: jax.Array,          # f32 [Q]   normalized historical usage
+    priority: jax.Array,       # i32 [Q]
+    seg: jax.Array,            # i32 [Q]   sibling-segment id (parent+1)
+    creation: jax.Array,       # i32 [Q]   tie-break, lower = older
+    active: jax.Array,         # bool [Q]  queue participates at this level
+    k_value: jax.Array,        # f32 []
+) -> jax.Array:
+    """Fair share for one resource across all sibling segments at one level."""
+    S = seg_total.shape[0]
+    q_total = seg_total[seg]                      # segment total seen by queue
+
+    unlimited_limit = limit <= UNLIMITED + 0.5
+    requestable = jnp.where(unlimited_limit, request, jnp.minimum(request, limit))
+    requestable = jnp.maximum(requestable, 0.0)
+    deserved = jnp.where(quota <= UNLIMITED + 0.5, q_total, quota)
+
+    # -- pass 1: deserved (ref setDeservedResource) ------------------------
+    fs = jnp.where(active, jnp.minimum(deserved, requestable), 0.0)
+    remaining = jnp.maximum(seg_total - _segment_sum(fs, seg, S), 0.0)
+
+    def unsatisfied(fs):
+        # ref isQueueSatisfied, inverted
+        sat = (request <= fs) | (~unlimited_limit & (limit <= fs))
+        return active & ~sat
+
+    # -- pass 2: over-quota by priority tier (ref divideOverQuotaResource) -
+    def tier_cond(carry):
+        fs, remaining, rem_frac, processed = carry
+        cand = unsatisfied(fs) & (weight > 0) & ~processed
+        return jnp.any(cand & (remaining[seg] > 0))
+
+    def tier_body(carry):
+        fs, remaining, rem_frac, processed = carry
+        cand = unsatisfied(fs) & (weight > 0) & ~processed
+        # highest unprocessed priority per segment forms the current tier
+        pr = jnp.where(cand, priority, _NEG_INF)
+        cur_p = jax.ops.segment_max(pr, seg, num_segments=S)
+        tier = cand & (priority == cur_p[seg])
+
+        def fill_cond(c):
+            fs, remaining, rem_frac, again = c
+            return again
+
+        def fill_body(c):
+            fs, remaining, rem_frac, _ = c
+            unsat = unsatisfied(fs) & tier
+            remreq = jnp.where(unsat, jnp.maximum(requestable - fs, 0.0), 0.0)
+            wants = unsat & (remreq > 0)
+            # normalize weights among wanting queues (ref calcShareWeights)
+            tot_w = _segment_sum(jnp.where(wants, weight, 0.0), seg, S)
+            n_w = jnp.where(wants & (tot_w[seg] > 0), weight / jnp.maximum(tot_w[seg], 1e-30), 0.0)
+            share_w = jnp.maximum(0.0, n_w + k_value * (n_w - usage)) * wants
+            sum_w = _segment_sum(share_w, seg, S)
+            ok = wants & (sum_w[seg] > 0)
+            fair = jnp.where(ok, remaining[seg] * share_w / jnp.maximum(sum_w[seg], 1e-30), 0.0)
+            satisfied_now = remreq <= fair
+            give = jnp.where(ok, jnp.where(satisfied_now, remreq, jnp.floor(fair)), 0.0)
+            new_rem = jnp.where(ok & ~satisfied_now, fair - jnp.floor(fair), 0.0)
+            # keep earlier remainder if this round gave this queue nothing new
+            rem_frac = jnp.where(ok, new_rem, jnp.where(tier & satisfied_now, 0.0, rem_frac))
+            fs = fs + give
+            gave = _segment_sum(give, seg, S)
+            remaining = jnp.maximum(remaining - gave, 0.0)
+            # another round only if someone was capped by request below its
+            # round fair share (freed amount can be re-divided) — ref
+            # shouldRunAnotherRound
+            freed = _segment_sum(jnp.where(ok & satisfied_now & (remreq < fair), 1.0, 0.0), seg, S)
+            again = jnp.any((freed > 0) & (remaining > 0) & (gave > 0))
+            return fs, remaining, rem_frac, again
+
+        fs, remaining, rem_frac, _ = lax.while_loop(
+            fill_cond, fill_body,
+            (fs, remaining, rem_frac, jnp.asarray(True)))
+        processed = processed | tier
+        return fs, remaining, rem_frac, processed
+
+    rem_frac = jnp.zeros_like(fs)
+    processed = jnp.zeros_like(active)
+    fs, remaining, rem_frac, _ = lax.while_loop(
+        tier_cond, tier_body, (fs, remaining, rem_frac, processed))
+
+    # -- pass 3: whole-unit remainders (ref divideRemainingResource) -------
+    # order: priority desc, fractional remainder desc, creation asc.
+    has_rem = active & (rem_frac > 0)
+    # pairwise in-segment rank (Q is small; Q^2 is cheap on device)
+    same_seg = seg[:, None] == seg[None, :]
+    pi, pj = priority[:, None], priority[None, :]
+    ri, rj = rem_frac[:, None], rem_frac[None, :]
+    ci, cj = creation[:, None], creation[None, :]
+    j_before_i = (pj > pi) | ((pj == pi) & (rj > ri)) | \
+                 ((pj == pi) & (rj == ri) & (cj < ci))
+    rank = jnp.sum(same_seg & has_rem[None, :] & j_before_i, axis=1)
+    give3 = jnp.where(has_rem, jnp.clip(remaining[seg] - rank, 0.0, 1.0), 0.0)
+    fs = fs + give3
+    return fs
+
+
+def divide_level(
+    queues: QueueState,
+    seg_total: jax.Array,   # f32 [Q+1, R]  totals per segment (slot 0 = root)
+    level_mask: jax.Array,  # bool [Q]
+    k_value: jax.Array,
+) -> jax.Array:
+    """Run the three-pass division for every resource at one hierarchy level."""
+    seg = jnp.where(queues.parent >= 0, queues.parent + 1, 0)
+    fs = jax.vmap(
+        _divide_one_resource,
+        in_axes=(1, 1, 1, 1, 1, 1, None, None, None, None, None),
+        out_axes=1,
+    )(
+        seg_total, queues.quota, queues.over_quota_weight, queues.limit,
+        queues.request, queues.usage, queues.priority, seg,
+        queues.creation_order, level_mask, k_value,
+    )
+    return fs
+
+
+def set_fair_share(
+    state: ClusterState,
+    *,
+    num_levels: int,
+    k_value: float = 0.0,
+) -> jax.Array:
+    """Compute ``fair_share [Q, R]`` for the whole hierarchy.
+
+    TPU analogue of ``SetResourcesShare`` (``resource_division.go:26-41``)
+    plus the hierarchical recursion described in ``docs/fairness/README.md``:
+    level 0 divides the cluster total; level d divides each parent's fair
+    share among its children.  ``num_levels`` is static (snapshot-known).
+    """
+    q = state.queues
+    k = jnp.asarray(k_value, q.quota.dtype)
+    total = state.total_capacity                      # [R]
+    fair_share = jnp.zeros_like(q.quota)
+    for depth in range(num_levels):
+        seg_total = jnp.concatenate([total[None, :], fair_share], axis=0)
+        level_mask = q.valid & (q.depth == depth)
+        fs_level = divide_level(q, seg_total, level_mask, k)
+        fair_share = jnp.where(level_mask[:, None], fs_level, fair_share)
+    return fair_share

@@ -1,0 +1,197 @@
+"""DRF division parity tests.
+
+Scenario expectations mirror the reference's behavioral spec in
+``pkg/scheduler/plugins/proportion/resource_division/resource_division_test.go``
+(setResourceShare / divideOverQuotaResource tables) — same inputs, same
+expected fair shares, computed by the TPU kernel instead of Go.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.apis.types import UNLIMITED
+from kai_scheduler_tpu.ops import drf
+
+
+def one_level(total, quota, weight, limit, request, priority=None, usage=None,
+              creation=None, k=0.0):
+    """Divide `total` among one flat group of queues; returns fair shares."""
+    n = len(quota)
+    as_f = lambda x: jnp.asarray(x, jnp.float32)
+    fs = drf._divide_one_resource(
+        seg_total=as_f([total]),
+        quota=as_f(quota),
+        weight=as_f(weight),
+        limit=as_f(limit),
+        request=as_f(request),
+        usage=as_f(usage if usage is not None else [0.0] * n),
+        priority=jnp.asarray(priority if priority is not None else [0] * n, jnp.int32),
+        seg=jnp.zeros((n,), jnp.int32),
+        creation=jnp.asarray(creation if creation is not None else list(range(n)), jnp.int32),
+        active=jnp.ones((n,), bool),
+        k_value=jnp.asarray(k, jnp.float32),
+    )
+    return np.asarray(fs)
+
+
+U = UNLIMITED
+
+
+class TestSingleQueue:
+    """Ref: 'single queue within quota (sanity)' table."""
+
+    def test_gives_requested_no_remaining(self):
+        assert one_level(2, [3], [0], [U], [2]) == [2.0]
+
+    def test_gives_requested_with_remaining(self):
+        assert one_level(3, [3], [0], [U], [2]) == [2.0]
+
+    def test_respects_max_allowed(self):
+        assert one_level(3, [3], [0], [2], [2]) == [2.0]
+
+    def test_oversubscribed_gives_requested_deserved(self):
+        # deserved min(3, 2)=2 even when total is 1 (deserved pass is a
+        # guarantee, not bounded by the total — ref setDeservedResource)
+        assert one_level(1, [3], [0], [U], [2]) == [2.0]
+
+    def test_caps_at_deserved(self):
+        assert one_level(7, [3], [0], [U], [5]) == [3.0]
+
+    def test_fractional_deserved(self):
+        assert one_level(2, [1.5], [0], [U], [2]) == [1.5]
+
+    def test_fractional_request(self):
+        assert one_level(2, [3], [0], [U], [1.5]) == [1.5]
+
+    def test_zero_deserved_gives_nothing(self):
+        assert one_level(2, [0], [0], [U], [2]) == [0.0]
+
+
+class TestSingleQueueOverQuota:
+    """Ref: 'single queue over quota (sanity)' table."""
+
+    def test_over_quota_up_to_request(self):
+        assert one_level(5, [3], [1], [U], [5]) == [5.0]
+
+    def test_over_quota_respects_max_allowed(self):
+        assert one_level(5, [3], [1], [4], [5]) == [4.0]
+
+    def test_zero_weight_gets_no_over_quota(self):
+        assert one_level(5, [3], [0], [U], [5]) == [3.0]
+
+    def test_fractional_over_quota_request(self):
+        assert one_level(5, [3], [1], [U], [4.5]) == [4.5]
+
+    def test_remainder_fraction(self):
+        assert one_level(3.5, [3], [1], [U], [5]) == [3.5]
+
+    def test_zero_deserved_still_gets_over_quota(self):
+        assert one_level(6, [0], [1], [U], [5]) == [5.0]
+
+
+class TestTwoQueues:
+    """Ref: 'two queues' DescribeTable."""
+
+    def test_allocates_many_available(self):
+        fs = one_level(15, [2, 2], [2, 2], [U, U], [6, 6])
+        np.testing.assert_allclose(fs, [6, 6])
+
+    def test_allocates_exact(self):
+        fs = one_level(12, [2, 2], [2, 2], [U, U], [6, 6])
+        np.testing.assert_allclose(fs, [6, 6])
+
+    def test_allocates_proportionally(self):
+        fs = one_level(8, [2, 2], [1, 3], [U, U], [6, 6])
+        np.testing.assert_allclose(fs, [3, 5])
+
+    def test_respects_max_allowed(self):
+        fs = one_level(12, [2, 2], [2, 2], [5, U], [6, 6])
+        np.testing.assert_allclose(fs, [5, 6])
+
+    def test_remainder_by_largest_remaining(self):
+        # 7 surplus, weights 1:4 -> fair 1.4/5.6 floored to 1/5; the last
+        # whole unit goes to queue 2 (largest fractional remainder)
+        fs = one_level(11, [2, 2], [1, 4], [U, U], [10, 10])
+        np.testing.assert_allclose(fs, [3, 8])
+
+    def test_remainder_by_creation_time(self):
+        # equal weights -> 3.5/3.5 floored to 3/3; extra unit to the older
+        fs = one_level(11, [2, 2], [2, 2], [U, U], [6, 6], creation=[0, 1])
+        np.testing.assert_allclose(fs, [6, 5])
+
+    def test_priority_does_not_affect_deserved(self):
+        fs = one_level(4, [2, 2], [2, 2], [U, U], [6, 6], priority=[1, 2])
+        np.testing.assert_allclose(fs, [2, 2])
+
+    def test_priority_affects_over_quota(self):
+        fs = one_level(6, [2, 2], [2, 2], [U, U], [6, 6], priority=[1, 2])
+        np.testing.assert_allclose(fs, [2, 4])
+
+    def test_priority_beats_weight(self):
+        fs = one_level(6, [2, 2], [100, 1], [U, U], [6, 6], priority=[1, 2])
+        np.testing.assert_allclose(fs, [2, 4])
+
+
+class TestKValueUsage:
+    """shareWeight = max(0, w + k*(w - usage)) — the time-based fairshare
+    hook (ref calcShareWeights)."""
+
+    def test_usage_penalizes_share(self):
+        # equal weights, queue 0 has historical usage: with k=1 its share
+        # weight halves (0.5 + 1*(0.5-0.25)=0.75 vs 0.5+1*(0.5-0)=1.0... )
+        fs = one_level(8, [0, 0], [1, 1], [U, U], [8, 8], usage=[0.25, 0.0], k=1.0)
+        assert fs[0] < fs[1]
+        np.testing.assert_allclose(fs.sum(), 8.0)
+
+    def test_k_zero_ignores_usage(self):
+        fs = one_level(8, [0, 0], [1, 1], [U, U], [8, 8], usage=[0.25, 0.0], k=0.0)
+        np.testing.assert_allclose(fs, [4, 4])
+
+
+class TestHierarchy:
+    def _mini_state(self):
+        from kai_scheduler_tpu.apis import types as apis
+        from kai_scheduler_tpu.state import build_snapshot, make_cluster
+        nodes, queues, groups, pods, topo = make_cluster(
+            num_nodes=4, node_accel=8.0,  # 32 accel total
+            num_departments=2, queues_per_department=2,
+            num_gangs=8, tasks_per_gang=8, task_accel=1.0)  # every queue asks 16
+        return build_snapshot(nodes, queues, groups, pods, topo)
+
+    def test_two_level_division(self):
+        state, index = self._mini_state()
+        fs = drf.set_fair_share(state, num_levels=2)
+        fs = np.asarray(fs)
+        i = {n: j for j, n in enumerate(index.queue_names)}
+        # each department deserves 16 accel; children 8 each; surplus splits
+        # evenly -> every leaf queue should land on its 8-quota
+        for d in range(2):
+            np.testing.assert_allclose(fs[i[f"dept-{d}"], 0], 16.0)
+            for j in range(2):
+                np.testing.assert_allclose(fs[i[f"queue-{d}-{j}"], 0], 8.0)
+
+    def test_children_cannot_exceed_parent_share(self):
+        from kai_scheduler_tpu.apis import types as apis
+        from kai_scheduler_tpu.state import build_snapshot
+        nodes = [apis.Node(f"n{k}", apis.ResourceVec(8, 0, 0)) for k in range(2)]
+        queues = [
+            apis.Queue("deptA", accel=apis.QueueResource(quota=4, over_quota_weight=1)),
+            apis.Queue("deptB", accel=apis.QueueResource(quota=12, over_quota_weight=1)),
+            apis.Queue("a1", parent="deptA", accel=apis.QueueResource(quota=4, over_quota_weight=1)),
+            apis.Queue("b1", parent="deptB", accel=apis.QueueResource(quota=12, over_quota_weight=1)),
+        ]
+        groups = [apis.PodGroup(f"g{k}", queue=q, min_member=1) for k, q in
+                  enumerate(["a1", "b1"])]
+        pods = []
+        for k, g in enumerate(groups):
+            for t in range(16):
+                pods.append(apis.Pod(f"p{k}-{t}", group=g.name,
+                                     resources=apis.ResourceVec(1, 0, 0)))
+        state, index = build_snapshot(nodes, queues, groups, pods, None)
+        fs = np.asarray(drf.set_fair_share(state, num_levels=2))
+        i = {n: j for j, n in enumerate(index.queue_names)}
+        # 16 total: deserved 4+12; a1 limited by deptA's share
+        np.testing.assert_allclose(fs[i["deptA"], 0], 4.0)
+        np.testing.assert_allclose(fs[i["deptB"], 0], 12.0)
+        np.testing.assert_allclose(fs[i["a1"], 0], 4.0)
+        np.testing.assert_allclose(fs[i["b1"], 0], 12.0)
