@@ -1,0 +1,252 @@
+"""The allocate action — gang all-or-nothing placement as one compiled scan.
+
+Reference hot path (``actions/allocate/allocate.go:52-156`` →
+``actions/common/allocate.go:26-355``): pop jobs from the fairness heap;
+per job open a Statement, greedily place each task on its best-scoring
+feasible node, and commit iff at least ``minMember`` tasks landed —
+otherwise roll the Statement back.  The per-task inner loop
+(``allocateTask``, ``allocate.go:229``) is O(nodes) of predicate +
+scoring work per task, fanned out over goroutines.
+
+TPU-native design: one ``lax.scan`` whose carry is the *functional
+cluster state* (free [N,R], per-queue allocation [Q,R], placement
+tables).  Each step:
+
+1. selects the next gang on-device (``ordering.select_next_gang`` — the
+   dynamic two-level heap), then
+2. runs a ``fori_loop`` over the gang's task slots; each task does a
+   broadcast predicate mask + score over ALL nodes at once (the vmapped
+   replacement for the goroutine fan-out) and a masked argmax pick, and
+3. commits or discards the whole gang with ``jnp.where`` — checkpoint/
+   rollback (``framework/statement.go:43-60``) becomes selection between
+   the pre-gang and post-gang carries; no op log needed.
+
+Pipelining: a task that only fits once terminating pods release
+(``Releasing`` resources) is placed with ``pipelined=True`` — the
+equivalent of ``stmt.Pipeline`` vs ``stmt.Allocate``.  Accounting runs
+against the combined idle+releasing pool, matching the reference's
+virtual allocation of releasing capacity.
+
+Queue capacity gates (proportion plugin ``capacity_policy``): each task
+checks, along the queue's ancestor chain, that allocation stays within
+``limit`` (maxAllowed) and — for non-preemptible gangs — within
+``quota`` (deserved).  A gang whose first ``minMember`` tasks cannot all
+pass the gate fails wholesale via the same rollback mechanism.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..apis.types import UNLIMITED
+from ..state.cluster_state import ClusterState
+from . import ordering
+from .predicates import feasible_nodes
+from .scoring import PlacementConfig, score_nodes_for_task
+
+EPS = 1e-6
+
+
+class AllocationResult(struct.PyTreeNode):
+    """Outcome tensors of one allocate pass (the Statement commit set)."""
+
+    placements: jax.Array     # i32 [G, T]  node index per task, -1 unplaced
+    pipelined: jax.Array      # bool [G, T] placed onto releasing resources
+    allocated: jax.Array      # bool [G]    gang committed this cycle
+    attempted: jax.Array      # bool [G]    gang was popped and tried
+    free: jax.Array           # f32 [N, R]  idle+releasing pool after commits
+    queue_allocated: jax.Array  # f32 [Q, R]
+    queue_allocated_nonpreemptible: jax.Array  # f32 [Q, R]
+
+
+def _ancestor_scatter(parent: jax.Array, q: jax.Array, num_levels: int,
+                      arr: jax.Array, delta: jax.Array) -> jax.Array:
+    """Add ``delta`` [R] to ``arr`` [Q, R] at queue ``q`` and its ancestors."""
+    def hop(_, carry):
+        arr, cur = carry
+        valid = cur >= 0
+        idx = jnp.maximum(cur, 0)
+        arr = arr.at[idx].add(jnp.where(valid, delta, 0.0))
+        nxt = jnp.where(valid, parent[idx], -1)
+        return arr, nxt
+    arr, _ = lax.fori_loop(0, num_levels, hop, (arr, q))
+    return arr
+
+
+def _ancestor_gate(parent: jax.Array, q: jax.Array, num_levels: int,
+                   used: jax.Array, cap: jax.Array, req: jax.Array) -> jax.Array:
+    """True iff ``used[a] + req <= cap[a]`` (per resource, UNLIMITED caps
+    skipped) for queue ``q`` and every ancestor ``a``."""
+    def hop(_, carry):
+        ok, cur = carry
+        valid = cur >= 0
+        idx = jnp.maximum(cur, 0)
+        cap_q = cap[idx]
+        unlimited = cap_q <= UNLIMITED + 0.5
+        fits = jnp.all(unlimited | (used[idx] + req <= cap_q + EPS))
+        ok = ok & (~valid | fits)
+        nxt = jnp.where(valid, parent[idx], -1)
+        return ok, nxt
+    ok, _ = lax.fori_loop(0, num_levels, hop, (jnp.asarray(True), q))
+    return ok
+
+
+@dataclasses.dataclass(frozen=True)
+class AllocateConfig:
+    """Knobs of the allocate action (ref CLI flags + SchedulingShard)."""
+
+    placement: PlacementConfig = PlacementConfig()
+    #: max gangs attempted per cycle — ref ``QueueDepthPerAction``;
+    #: None = all valid gangs.
+    queue_depth: int | None = None
+    #: re-sort the queue heap after every allocation (exact reference
+    #: semantics) vs freeze the order at cycle start (faster at large G).
+    dynamic_order: bool = True
+
+
+def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
+                  free: jax.Array, q_alloc: jax.Array, q_alloc_np: jax.Array,
+                  num_levels: int, config: AllocateConfig):
+    """Try to place one gang; returns tentative post-gang state + success."""
+    g = state.gangs
+    n = state.nodes
+    T = g.t
+    task_req = g.task_req[gang_idx]          # [T, R]
+    task_valid = g.task_valid[gang_idx]      # [T]
+    task_sel = g.task_selector[gang_idx]     # [T, K]
+    task_portion = g.task_portion[gang_idx]  # [T]
+    queue = g.queue[gang_idx]
+    nonpreempt = ~g.preemptible[gang_idx]
+
+    def task_body(t, carry):
+        free_l, qa, qan, nodes_t, pipe_t, count = carry
+        req = task_req[t]
+        # queue capacity gates up the hierarchy (capacity_policy.go:26-50)
+        gate = _ancestor_gate(state.queues.parent, queue, num_levels,
+                              qa, state.queues.limit, req)
+        gate = gate & jnp.where(
+            nonpreempt,
+            _ancestor_gate(state.queues.parent, queue, num_levels,
+                           qan, state.queues.quota, req),
+            True)
+        ok = task_valid[t] & gate
+
+        fit_idle = feasible_nodes(
+            n, req, task_sel[t], task_portion[t], free=free_l)        # [N]
+        fit_pipe = feasible_nodes(
+            n, req, task_sel[t], task_portion[t], free=free_l,
+            include_releasing=True)                                    # [N]
+        scores = score_nodes_for_task(
+            n, free_l, req, fit_idle, fit_pipe, config.placement)      # [N]
+        node = jnp.argmax(scores)
+        placed = ok & jnp.any(fit_pipe)
+        is_pipe = placed & ~fit_idle[node]
+
+        delta = jnp.where(placed, req, 0.0)
+        free_l = free_l.at[node].add(-delta)
+        qa = _ancestor_scatter(state.queues.parent, queue, num_levels, qa, delta)
+        qan = _ancestor_scatter(
+            state.queues.parent, queue, num_levels, qan,
+            jnp.where(nonpreempt, delta, 0.0))
+        nodes_t = nodes_t.at[t].set(jnp.where(placed, node, -1))
+        pipe_t = pipe_t.at[t].set(is_pipe)
+        count = count + placed.astype(jnp.int32)
+        return free_l, qa, qan, nodes_t, pipe_t, count
+
+    init = (free, q_alloc, q_alloc_np,
+            jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
+            jnp.asarray(0, jnp.int32))
+    free2, qa2, qan2, nodes_t, pipe_t, count = lax.fori_loop(
+        0, T, task_body, init)
+    success = count >= g.min_member[gang_idx]
+    return free2, qa2, qan2, nodes_t, pipe_t, success
+
+
+def allocate(
+    state: ClusterState,
+    fair_share: jax.Array,          # f32 [Q, R]  from ops.drf.set_fair_share
+    *,
+    num_levels: int,
+    config: AllocateConfig = AllocateConfig(),
+) -> AllocationResult:
+    """Run the allocate action over every pending gang.
+
+    Functional equivalent of ``allocate.Execute`` — jit-compatible; all
+    shapes static.  ``num_levels`` bounds the queue-hierarchy depth
+    (snapshot-known static).
+    """
+    g, n, q = state.gangs, state.nodes, state.queues
+    G, T = g.g, g.t
+    total = state.total_capacity
+    steps = G if config.queue_depth is None else min(G, config.queue_depth)
+
+    # Releasing capacity participates in the pool (pipeline placements);
+    # the free carry is the *idle* pool and may dip negative by at most
+    # each node's releasing amount — feasibility always checks the sum.
+    static_order = None
+    if not config.dynamic_order:
+        static_order = ordering.static_job_order(
+            g, q, q.allocated, fair_share, total)
+
+    def step(carry, step_idx):
+        free, qa, qan, remaining, placements, pipelined, allocated, attempted = carry
+        if config.dynamic_order:
+            gi = ordering.select_next_gang(g, q, qa, fair_share, total, remaining)
+        else:
+            gi = static_order[step_idx]
+        runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0)
+
+        def attempt(args):
+            free, qa, qan = args
+            free2, qa2, qan2, nodes_t, pipe_t, success = _attempt_gang(
+                state, gi, free, qa, qan, num_levels, config)
+            # checkpoint/rollback: keep post-gang state only on success
+            sel = lambda a, b: jnp.where(success, a, b)
+            return (sel(free2, free), sel(qa2, qa), sel(qan2, qan),
+                    jnp.where(success, nodes_t, -jnp.ones_like(nodes_t)),
+                    jnp.where(success, pipe_t, jnp.zeros_like(pipe_t)),
+                    success)
+
+        def skip(args):
+            free, qa, qan = args
+            return (free, qa, qan, jnp.full((T,), -1, jnp.int32),
+                    jnp.zeros((T,), bool), jnp.asarray(False))
+
+        free, qa, qan, nodes_t, pipe_t, success = lax.cond(
+            runnable, attempt, skip, (free, qa, qan))
+        placements = placements.at[gi].set(
+            jnp.where(runnable, nodes_t, placements[gi]))
+        pipelined = pipelined.at[gi].set(
+            jnp.where(runnable, pipe_t, pipelined[gi]))
+        allocated = allocated.at[gi].set(allocated[gi] | success)
+        attempted = attempted.at[gi].set(attempted[gi] | runnable)
+        remaining = remaining.at[gi].set(False)
+        return (free, qa, qan, remaining, placements, pipelined,
+                allocated, attempted), None
+
+    init = (
+        n.free, q.allocated, q.allocated_nonpreemptible,
+        g.valid & (g.backoff <= 0),
+        jnp.full((G, T), -1, jnp.int32),
+        jnp.zeros((G, T), bool),
+        jnp.zeros((G,), bool),
+        jnp.zeros((G,), bool),
+    )
+    (free, qa, qan, _, placements, pipelined, allocated, attempted), _ = lax.scan(
+        step, init, jnp.arange(steps))
+    return AllocationResult(
+        placements=placements, pipelined=pipelined, allocated=allocated,
+        attempted=attempted, free=free, queue_allocated=qa,
+        queue_allocated_nonpreemptible=qan)
+
+
+@functools.partial(jax.jit, static_argnames=("num_levels", "config"))
+def allocate_jit(state: ClusterState, fair_share: jax.Array, *,
+                 num_levels: int, config: AllocateConfig = AllocateConfig()
+                 ) -> AllocationResult:
+    return allocate(state, fair_share, num_levels=num_levels, config=config)

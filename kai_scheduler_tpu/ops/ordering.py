@@ -1,0 +1,117 @@
+"""Queue-of-queues job ordering — the two-level priority heap, tensorized.
+
+The reference pops the next job from a heap of queues ordered by the
+proportion plugin's QueueOrderFn and, within a queue, by JobOrderFn
+(``actions/utils/job_order_by_queue.go:38`` JobsOrderByQueues).  The heap
+is *dynamic*: every allocation changes the owning queue's allocated share
+and re-sorts it.  Here the pop is an on-device ``lexsort`` over composite
+keys, recomputed each scan step from the live allocation tensors — same
+semantics, no heap.
+
+Queue comparison tiers (``plugins/proportion/queue_order/queue_order.go``
+``GetQueueOrderResult``):
+1. under-fair-share queues before over-fair-share queues
+2. under-quota before over-quota
+3. higher queue priority first
+4. smaller dominant resource share (allocated / cluster total) first
+5. creation time (older first)
+
+Job tiers within a queue (priority plugin + elastic plugin +
+default creation order):
+1. below-min-member gangs first (elastic ``plugins/elastic/elastic.go:38``)
+2. higher podgroup priority first
+3. older first
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..apis.types import UNLIMITED
+from ..state.cluster_state import GangState, QueueState
+
+BIG = jnp.float32(1e30)
+
+
+def queue_order_keys(
+    queues: QueueState,
+    queue_allocated: jax.Array,   # f32 [Q, R]  live allocation (incl. this cycle)
+    fair_share: jax.Array,        # f32 [Q, R]  DRF division output
+    total: jax.Array,             # f32 [R]     cluster capacity
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-queue comparison keys (smaller = schedule sooner).
+
+    Returns (over_fair_share, over_quota, neg_priority, dominant_share),
+    each [Q] float32.
+    """
+    eps = 1e-6
+    over_fs = jnp.any(queue_allocated > fair_share + eps, axis=-1)
+    quota_eff = jnp.where(queues.quota <= UNLIMITED + 0.5, BIG, queues.quota)
+    over_quota = jnp.any(queue_allocated > quota_eff + eps, axis=-1)
+    safe_total = jnp.maximum(total, eps)
+    dom_share = jnp.max(queue_allocated / safe_total[None, :], axis=-1)
+    return (
+        over_fs.astype(jnp.float32),
+        over_quota.astype(jnp.float32),
+        -queues.priority.astype(jnp.float32),
+        dom_share,
+    )
+
+
+def select_next_gang(
+    gangs: GangState,
+    queues: QueueState,
+    queue_allocated: jax.Array,   # f32 [Q, R]
+    fair_share: jax.Array,        # f32 [Q, R]
+    total: jax.Array,             # f32 [R]
+    remaining: jax.Array,         # bool [G]  gangs not yet attempted
+) -> jax.Array:
+    """Index of the next gang to attempt (i32 scalar; any index if none
+    remain — callers must also branch on ``jnp.any(remaining)``).
+
+    Equivalent to one ``PopNextJob`` from the two-level heap.
+    """
+    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
+        queues, queue_allocated, fair_share, total)
+    qi = gangs.queue
+    not_rem = (~remaining).astype(jnp.float32)
+    below_min = jnp.sum(gangs.task_valid, axis=-1) < gangs.min_member
+    # lexsort: LAST key is most significant.
+    order = jnp.lexsort((
+        gangs.creation_order.astype(jnp.float32),
+        -gangs.priority.astype(jnp.float32),
+        (~below_min).astype(jnp.float32),   # elastic: below-min gangs first
+        gangs.creation_order.astype(jnp.float32) * 0 + dom_share[qi],
+        neg_prio[qi],
+        over_quota[qi],
+        over_fs[qi],
+        not_rem,                            # exhausted gangs last
+    ))
+    return order[0]
+
+
+def static_job_order(
+    gangs: GangState,
+    queues: QueueState,
+    queue_allocated: jax.Array,
+    fair_share: jax.Array,
+    total: jax.Array,
+) -> jax.Array:
+    """One-shot permutation [G] — the cheap path that freezes the heap at
+    cycle start (queue keys do not react to this cycle's allocations).
+    Used when ``dynamic_order=False`` for large-G throughput.
+    """
+    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
+        queues, queue_allocated, fair_share, total)
+    qi = gangs.queue
+    below_min = jnp.sum(gangs.task_valid, axis=-1) < gangs.min_member
+    return jnp.lexsort((
+        gangs.creation_order.astype(jnp.float32),
+        -gangs.priority.astype(jnp.float32),
+        (~below_min).astype(jnp.float32),
+        dom_share[qi],
+        neg_prio[qi],
+        over_quota[qi],
+        over_fs[qi],
+        (~gangs.valid).astype(jnp.float32),
+    ))

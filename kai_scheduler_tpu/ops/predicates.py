@@ -1,0 +1,108 @@
+"""Pod×node feasibility masks — the predicates plugin, tensorized.
+
+The reference checks each candidate node for a task through a chain of
+predicate functions (``plugins/predicates/predicates.go:104-130`` wrapping
+upstream kube-scheduler filters, dispatched per node in
+``framework/session.go:201-232`` ``FittingNode``).  That is an O(nodes)
+host loop per task; here the whole chain is a single broadcast expression
+producing a boolean ``[..., N]`` mask, evaluated for every task at once
+(vmapped over the task axis) on the MXU-adjacent vector units.
+
+Covered predicate surface (the resource+label subset per SURVEY.md §7
+"hard parts" (6); exotic predicates stay host-side fallbacks):
+
+- node validity (schedulable, in-partition)
+- resource fit against ``free`` (idle) resources
+- resource fit against ``free + releasing`` (the *pipeline* variant the
+  reference uses to queue a task behind terminating pods)
+- nodeSelector equality matching via the label-vocabulary encoding
+- fractional accelerator fit (portion ≤ free accel, cf. gpu_sharing)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..apis.types import RESOURCE_ACCEL
+from ..state.cluster_state import NodeState
+
+EPS = 1e-6
+
+
+def selector_mask(node_labels: jax.Array, task_selector: jax.Array) -> jax.Array:
+    """nodeSelector match — ref upstream NodeAffinity/selector filter.
+
+    ``node_labels``  i32 [N, K]  label value-id per selector key (-1 unset)
+    ``task_selector`` i32 [..., K] required value-id per key (-1 = any)
+
+    Returns bool [..., N]: True where every required key matches.
+    """
+    required = task_selector[..., None, :] >= 0              # [..., 1, K]
+    matches = node_labels == task_selector[..., None, :]     # [..., N, K]
+    return jnp.all(~required | matches, axis=-1)
+
+
+def resource_fit_mask(
+    available: jax.Array,      # f32 [N, R]
+    task_req: jax.Array,       # f32 [..., R]
+    task_portion: jax.Array | None = None,  # f32 [...]
+) -> jax.Array:
+    """True where the task's request fits the node's available vector.
+
+    A fractional task (portion > 0) requests ``portion`` of one device in
+    the accel slot instead of its whole-device count (the reference keeps
+    these in separate fields of GpuResourceRequirement; here the portion
+    overrides the accel component of the request when set).
+    """
+    req = jnp.asarray(task_req)
+    if task_portion is not None:
+        accel = jnp.where(task_portion > 0, task_portion, req[..., RESOURCE_ACCEL])
+        req = req.at[..., RESOURCE_ACCEL].set(accel)
+    return jnp.all(available + EPS >= req[..., None, :], axis=-1)
+
+
+def feasible_nodes(
+    nodes: NodeState,
+    task_req: jax.Array,        # f32 [..., R]
+    task_selector: jax.Array,   # i32 [..., K]
+    task_portion: jax.Array | None = None,
+    *,
+    free: jax.Array | None = None,
+    include_releasing: bool = False,
+) -> jax.Array:
+    """Full predicate chain → bool [..., N].
+
+    ``free`` overrides the snapshot's idle vector (the allocation kernel
+    passes its *running* free tensor as allocation proceeds).
+    ``include_releasing`` gives the pipeline variant: a node qualifies if
+    the task fits once terminating pods release their resources
+    (ref ``pod_info.IsTaskAllocatableOnReleasingOrIdle``).
+    """
+    avail = nodes.free if free is None else free
+    if include_releasing:
+        avail = avail + nodes.releasing
+    fit = resource_fit_mask(avail, task_req, task_portion)
+    sel = selector_mask(nodes.labels, task_selector)
+    return fit & sel & nodes.valid
+
+
+def gang_feasibility(
+    nodes: NodeState,
+    task_req: jax.Array,       # f32 [T, R]
+    task_valid: jax.Array,     # bool [T]
+    task_selector: jax.Array,  # i32 [T, K]
+    min_member: jax.Array,     # i32 []
+    *,
+    free: jax.Array | None = None,
+) -> jax.Array:
+    """Cheap whole-gang prefilter — ref ``actions/common/feasible_nodes.go:11``
+    (FeasibleNodesForJob) and the MinimalJobRepresentatives skip logic.
+
+    A gang is *hopeless* this cycle if fewer than ``min_member`` of its
+    tasks have any feasible node at all, counting each node's capacity only
+    coarsely (no cross-task capacity interaction — that is the allocation
+    kernel's job).  Returns a scalar bool (True = worth attempting).
+    """
+    per_task = feasible_nodes(nodes, task_req, task_selector, free=free)  # [T, N]
+    has_node = jnp.any(per_task, axis=-1) & task_valid
+    return jnp.sum(has_node.astype(jnp.int32)) >= min_member

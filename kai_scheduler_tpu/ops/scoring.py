@@ -1,0 +1,151 @@
+"""Node-order scoring — the NodeOrderFn plugin family, tensorized.
+
+The reference sums per-plugin scores for every candidate node in a
+goroutine fan-out (``framework/session.go:234-263`` ``OrderedNodesByTask``)
+then picks the best (``FittingNode``).  Here each plugin is a pure
+function producing a ``[..., N]`` score tensor and composition is a
+weighted sum — one fused XLA kernel per cycle instead of pods×nodes
+goroutine hops.
+
+Score bands follow ``plugins/scores/scores.go:7-14`` so plugin priorities
+compose exactly as in the reference: a higher band always dominates all
+lower bands combined (each band's raw score is ≤ MAX_HIGH_DENSITY = 9).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..apis.types import RESOURCE_ACCEL, RESOURCE_CPU
+from ..state.cluster_state import NodeState
+
+# ref plugins/scores/scores.go
+MAX_HIGH_DENSITY = 9.0
+W_RESOURCE_TYPE = 10.0
+W_AVAILABILITY = 100.0
+W_GPU_SHARING = 1_000.0
+W_TOPOLOGY = 10_000.0
+W_K8S_PLUGINS = 100_000.0
+W_NOMINATED = 1_000_000.0
+
+BIG_NEG = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementConfig:
+    """binpack vs spread per resource type — ref nodeplacement plugin args
+    (``conf_util/scheduler_conf_util.go:54-57``, default binpack) and
+    SchedulingShard.PlacementStrategy.
+    """
+
+    binpack_accel: bool = True
+    binpack_cpu: bool = True
+
+
+def density_score(
+    non_allocated: jax.Array,  # f32 [N]   allocatable - used  (free + releasing)
+    allocatable: jax.Array,    # f32 [N]
+    fit_mask: jax.Array,       # bool [..., N]  candidate nodes per task
+    *,
+    binpack: bool,
+) -> jax.Array:
+    """Binpack/spread score in [0, MAX_HIGH_DENSITY] — ref
+    ``nodeplacement/pack.go`` ``getScoreOfCurrentNode``: normalize each
+    node's non-allocated amount into the [min, max] range over *fitting*
+    nodes that have the resource at all; binpack rewards fuller nodes,
+    spread emptier ones.  min==max degenerates to max score for all.
+    """
+    has_res = allocatable > 0
+    cand = fit_mask & has_res
+    big = jnp.asarray(jnp.finfo(non_allocated.dtype).max)
+    mn = jnp.min(jnp.where(cand, non_allocated, big), axis=-1, keepdims=True)
+    mx = jnp.max(jnp.where(cand, non_allocated, -big), axis=-1, keepdims=True)
+    span = mx - mn
+    frac = jnp.where(span > 0, (non_allocated - mn) / jnp.maximum(span, 1e-30), 0.0)
+    raw = jnp.where(span > 0, (1.0 - frac) if binpack else frac, 1.0)
+    return jnp.where(cand, MAX_HIGH_DENSITY * raw, 0.0)
+
+
+def placement_score(
+    nodes: NodeState,
+    free: jax.Array,          # f32 [N, R]  current free (mid-allocation)
+    task_req: jax.Array,      # f32 [..., R]
+    fit_mask: jax.Array,      # bool [..., N]
+    config: PlacementConfig = PlacementConfig(),
+) -> jax.Array:
+    """nodeplacement plugin: density score on the task's dominant resource
+    type — accel nodes scored by accel density for accel tasks, cpu density
+    for cpu-only tasks (ref ``nodeplacement/nodeplacement.go`` jobType
+    switch).
+    """
+    non_alloc = free + nodes.releasing
+    is_accel_task = task_req[..., RESOURCE_ACCEL] > 0
+    accel_s = density_score(
+        non_alloc[:, RESOURCE_ACCEL], nodes.allocatable[:, RESOURCE_ACCEL],
+        fit_mask, binpack=config.binpack_accel)
+    cpu_s = density_score(
+        non_alloc[:, RESOURCE_CPU], nodes.allocatable[:, RESOURCE_CPU],
+        fit_mask, binpack=config.binpack_cpu)
+    return jnp.where(is_accel_task[..., None], accel_s, cpu_s)
+
+
+def resource_type_score(
+    nodes: NodeState,
+    task_req: jax.Array,      # f32 [..., R]
+) -> jax.Array:
+    """resourcetype plugin (``plugins/resourcetype``): +W_RESOURCE_TYPE when
+    a CPU-only task lands on a CPU-only node, keeping accel nodes clear for
+    accel work.
+    """
+    cpu_only_task = task_req[..., RESOURCE_ACCEL] <= 0
+    cpu_only_node = nodes.allocatable[:, RESOURCE_ACCEL] <= 0
+    return jnp.where(
+        cpu_only_task[..., None] & cpu_only_node, W_RESOURCE_TYPE, 0.0)
+
+
+def availability_score(
+    idle_fit: jax.Array,      # bool [..., N]  fits on idle (not releasing) res
+) -> jax.Array:
+    """nodeavailability plugin: +W_AVAILABILITY when the task fits on idle
+    resources now (vs only after terminating pods release) — biases toward
+    immediate binds over pipelined ones.
+    """
+    return jnp.where(idle_fit, W_AVAILABILITY, 0.0)
+
+
+def compose_scores(
+    fit_mask: jax.Array,       # bool [..., N]  hard feasibility (pipeline incl.)
+    *components: jax.Array,    # f32 [..., N] already weighted into their bands
+) -> jax.Array:
+    """Sum plugin bands and mask infeasible nodes to -inf — equivalent of
+    the per-node score accumulation in ``session.go:243-262``.
+    """
+    total = jnp.zeros_like(fit_mask, dtype=jnp.float32)
+    for c in components:
+        total = total + c
+    return jnp.where(fit_mask, total, BIG_NEG)
+
+
+def score_nodes_for_task(
+    nodes: NodeState,
+    free: jax.Array,           # f32 [N, R]
+    task_req: jax.Array,       # f32 [..., R]
+    fit_idle: jax.Array,       # bool [..., N]
+    fit_pipeline: jax.Array,   # bool [..., N]
+    config: PlacementConfig = PlacementConfig(),
+    extra: jax.Array | None = None,   # e.g. topology band, [..., N]
+) -> jax.Array:
+    """The default scoring stack (resourcetype + availability + placement),
+    mirroring the default plugin tiers in ``conf_util/scheduler_conf_util.go``.
+    Returns f32 [..., N] with infeasible nodes at BIG_NEG.
+    """
+    comps = [
+        placement_score(nodes, free, task_req, fit_pipeline, config),
+        resource_type_score(nodes, task_req),
+        availability_score(fit_idle),
+    ]
+    if extra is not None:
+        comps.append(extra)
+    return compose_scores(fit_pipeline, *comps)

@@ -1,0 +1,197 @@
+"""Allocate-action kernel tests — analogue of the reference's
+``actions/allocate/allocate_test.go`` + ``allocateGang_test.go`` suites
+(fake-cluster table tests from ``test_utils/``)."""
+import jax.numpy as jnp
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.state import build_snapshot, make_cluster
+
+
+def run_allocate(state, *, num_levels=2, **cfg):
+    fs = drf.set_fair_share(state, num_levels=num_levels)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    return allocate(state, fs, num_levels=num_levels,
+                    config=AllocateConfig(**cfg))
+
+
+def test_simple_allocation_places_all():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, node_accel=8.0, num_gangs=4, tasks_per_gang=2)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    res = run_allocate(state)
+    g_valid = np.asarray(state.gangs.valid)
+    assert np.asarray(res.allocated)[g_valid].all()
+    pl = np.asarray(res.placements)
+    tv = np.asarray(state.gangs.task_valid)
+    assert (pl[tv] >= 0).all()
+    assert (pl[~tv] == -1).all()
+
+
+def test_capacity_respected():
+    """8 gangs x 2 tasks x 1 accel onto one 8-accel node: exactly 4 gangs fit."""
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=1, node_accel=8.0, node_cpu=1000.0, node_mem=1000.0,
+        num_gangs=8, tasks_per_gang=2)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    res = run_allocate(state)
+    assert int(np.asarray(res.allocated).sum()) == 4
+    free = np.asarray(res.free)
+    assert free[0, apis.RESOURCE_ACCEL] >= -1e-5
+
+
+def test_gang_all_or_nothing():
+    """A gang needing 3 devices on a 2-device cluster must place nothing —
+    ref Statement rollback semantics (framework/statement.go:43-60)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=10))]
+    groups = [apis.PodGroup("gang", queue="q", min_member=3)]
+    pods = [apis.Pod(f"p{i}", "gang", apis.ResourceVec(1, 1, 1))
+            for i in range(3)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, num_levels=1)
+    assert not np.asarray(res.allocated)[0]
+    assert (np.asarray(res.placements)[0] == -1).all()
+    # free untouched by the rolled-back partial placement
+    np.testing.assert_allclose(np.asarray(res.free)[0],
+                               np.asarray(state.nodes.free)[0])
+
+
+def test_elastic_gang_partial_above_min():
+    """min_member=1 with 3 tasks on a 2-device node: gang commits with the
+    2 tasks that fit (elastic plugin semantics)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=10))]
+    groups = [apis.PodGroup("gang", queue="q", min_member=1)]
+    pods = [apis.Pod(f"p{i}", "gang", apis.ResourceVec(1, 1, 1))
+            for i in range(3)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, num_levels=1)
+    assert np.asarray(res.allocated)[0]
+    assert int((np.asarray(res.placements)[0] >= 0).sum()) == 2
+
+
+def test_queue_limit_gates_allocation():
+    """Queue with limit=1 accel can only take 1 of its 2 single-task gangs."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue(
+        "q", accel=apis.QueueResource(quota=1.0, limit=1.0))]
+    groups = [apis.PodGroup(f"g{i}", queue="q", min_member=1) for i in range(2)]
+    pods = [apis.Pod(f"p{i}", f"g{i}", apis.ResourceVec(1, 1, 1))
+            for i in range(2)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, num_levels=1)
+    assert int(np.asarray(res.allocated).sum()) == 1
+
+
+def test_nonpreemptible_gated_by_quota():
+    """Non-preemptible gangs must stay within deserved quota
+    (capacity_policy.IsNonPreemptibleJobOverQuota); preemptible ones may
+    go over quota up to the limit."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue(
+        "q", accel=apis.QueueResource(quota=1.0),
+        cpu=apis.QueueResource(quota=apis.UNLIMITED),
+        memory=apis.QueueResource(quota=apis.UNLIMITED))]
+
+    def mk(preempt):
+        groups = [apis.PodGroup(
+            f"g{i}", queue="q", min_member=1,
+            preemptibility=(apis.Preemptibility.PREEMPTIBLE if preempt
+                            else apis.Preemptibility.NON_PREEMPTIBLE))
+            for i in range(3)]
+        pods = [apis.Pod(f"p{i}", f"g{i}", apis.ResourceVec(1, 1, 1))
+                for i in range(3)]
+        return build_snapshot(nodes, queues, groups, pods)[0]
+
+    res_np = run_allocate(mk(False), num_levels=1)
+    assert int(np.asarray(res_np.allocated).sum()) == 1  # quota=1
+    res_p = run_allocate(mk(True), num_levels=1)
+    assert int(np.asarray(res_p.allocated).sum()) == 3   # no limit
+
+
+def test_hierarchical_limit_on_parent():
+    """Parent queue limit caps the sum of its children."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [
+        apis.Queue("dept", accel=apis.QueueResource(quota=4.0, limit=2.0)),
+        apis.Queue("a", parent="dept", accel=apis.QueueResource(quota=2.0)),
+        apis.Queue("b", parent="dept", accel=apis.QueueResource(quota=2.0)),
+    ]
+    groups = [apis.PodGroup(f"g{i}", queue=("a" if i % 2 == 0 else "b"),
+                            min_member=1) for i in range(4)]
+    pods = [apis.Pod(f"p{i}", f"g{i}", apis.ResourceVec(1, 1, 1))
+            for i in range(4)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, num_levels=2)
+    assert int(np.asarray(res.allocated).sum()) == 2
+
+
+def test_fairness_order_interleaves_queues():
+    """Two queues with equal quota on a cluster that only fits half the
+    demand: DRF ordering must give each queue its fair share rather than
+    letting the first queue drain the cluster."""
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=2, node_accel=4.0, node_cpu=1000.0, node_mem=1000.0,
+        num_departments=2, queues_per_department=1,
+        num_gangs=8, tasks_per_gang=2)   # demand 16 accel, capacity 8
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    res = run_allocate(state)
+    qi = np.asarray(state.gangs.queue)
+    alloc = np.asarray(res.allocated)
+    per_queue = {}
+    for gq, a in zip(qi[: len(groups)], alloc[: len(groups)]):
+        per_queue[gq] = per_queue.get(gq, 0) + int(a)
+    assert len(per_queue) == 2
+    counts = sorted(per_queue.values())
+    assert counts == [2, 2], counts
+
+
+def test_pipelined_placement_on_releasing():
+    """A task that fits only counting releasing resources gets placed with
+    pipelined=True (stmt.Pipeline equivalent)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(1, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=10))]
+    groups = [
+        apis.PodGroup("old", queue="q", min_member=1,
+                      last_start_timestamp=0.0),
+        apis.PodGroup("new", queue="q", min_member=1),
+    ]
+    pods = [
+        apis.Pod("vic", "old", apis.ResourceVec(1, 1, 1),
+                 status=apis.PodStatus.RELEASING, node="n0"),
+        apis.Pod("inc", "new", apis.ResourceVec(1, 1, 1)),
+    ]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, num_levels=1)
+    g = 1  # "new" is the second group
+    assert np.asarray(res.allocated)[g]
+    assert np.asarray(res.pipelined)[g, 0]
+
+
+def test_static_order_matches_dynamic_on_single_queue():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=2, node_accel=8.0, num_departments=1,
+        queues_per_department=1, num_gangs=6, tasks_per_gang=2)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    res_d = run_allocate(state, dynamic_order=True)
+    res_s = run_allocate(state, dynamic_order=False)
+    np.testing.assert_array_equal(
+        np.asarray(res_d.allocated), np.asarray(res_s.allocated))
+
+
+def test_jit_compiles_and_matches_eager():
+    import jax
+
+    from kai_scheduler_tpu.ops.allocate import allocate_jit
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, num_gangs=4, tasks_per_gang=2)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+    fs = drf.set_fair_share(state, num_levels=2)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    res_e = allocate(state, fs, num_levels=2)
+    res_j = allocate_jit(state, fs, num_levels=2)
+    np.testing.assert_array_equal(
+        np.asarray(res_e.placements), np.asarray(res_j.placements))

@@ -1,0 +1,114 @@
+"""Predicate-mask + scoring kernel tests — analogue of
+``plugins/predicates`` and ``plugins/nodeplacement/{nodepack,nodespread}_test.go``."""
+import jax.numpy as jnp
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.ops import predicates, scoring
+from kai_scheduler_tpu.state import build_snapshot, make_cluster
+
+
+def small_state(**kw):
+    nodes, queues, groups, pods, topo = make_cluster(**kw)
+    return build_snapshot(nodes, queues, groups, pods, topo)
+
+
+def test_resource_fit_basic():
+    state, _ = small_state(num_nodes=4, node_accel=8.0)
+    req = jnp.asarray([[4.0, 1.0, 1.0], [9.0, 1.0, 1.0]])  # fits / too big
+    sel = jnp.full((2, state.nodes.labels.shape[1]), -1, jnp.int32)
+    mask = predicates.feasible_nodes(state.nodes, req, sel)
+    m = np.asarray(mask)
+    assert m[0, :4].all()          # 4 accel fits every 8-accel node
+    assert not m[1].any()          # 9 accel fits nowhere
+    assert not m[:, 4:].any()      # padded nodes never feasible
+
+
+def test_selector_mask():
+    nodes = [
+        apis.Node("a", apis.ResourceVec(8, 8, 8), labels={"zone": "east"}),
+        apis.Node("b", apis.ResourceVec(8, 8, 8), labels={"zone": "west"}),
+    ]
+    queues = [apis.Queue("q")]
+    groups = [apis.PodGroup("g", queue="q", min_member=1)]
+    pods = [apis.Pod("p", "g", apis.ResourceVec(1, 1, 1),
+                     node_selector={"zone": "west"})]
+    state, idx = build_snapshot(nodes, queues, groups, pods)
+    mask = predicates.feasible_nodes(
+        state.nodes, state.gangs.task_req[0, 0],
+        state.gangs.task_selector[0, 0])
+    m = np.asarray(mask)
+    assert not m[idx.node_index("a")]
+    assert m[idx.node_index("b")]
+
+
+def test_fractional_portion_fit():
+    state, _ = small_state(num_nodes=2, node_accel=1.0)
+    req = jnp.asarray([2.0, 1.0, 1.0])     # 2 whole devices: doesn't fit
+    sel = jnp.full((state.nodes.labels.shape[1],), -1, jnp.int32)
+    whole = predicates.feasible_nodes(state.nodes, req, sel)
+    assert not np.asarray(whole)[:2].any()
+    # same pod as a 0.5-device fraction fits
+    frac = predicates.feasible_nodes(
+        state.nodes, req, sel, task_portion=jnp.asarray(0.5))
+    assert np.asarray(frac)[:2].all()
+
+
+def test_releasing_enables_pipeline_fit():
+    state, _ = small_state(num_nodes=2, node_accel=2.0)
+    free = state.nodes.free.at[0].set(jnp.asarray([0.0, 64.0, 256.0]))
+    nodes = state.nodes.replace(
+        free=free,
+        releasing=state.nodes.releasing.at[0].set(jnp.asarray([2.0, 0.0, 0.0])))
+    req = jnp.asarray([1.0, 1.0, 1.0])
+    sel = jnp.full((nodes.labels.shape[1],), -1, jnp.int32)
+    idle = predicates.feasible_nodes(nodes, req, sel)
+    pipe = predicates.feasible_nodes(nodes, req, sel, include_releasing=True)
+    assert not np.asarray(idle)[0] and np.asarray(pipe)[0]
+    assert np.asarray(idle)[1]
+
+
+def test_binpack_prefers_fuller_node():
+    """ref nodeplacement/pack.go getScoreOfCurrentNode: fewer non-allocated
+    resources => higher score under binpack; reversed under spread."""
+    state, _ = small_state(num_nodes=2, node_accel=8.0)
+    # node 0 fuller (2 free), node 1 empty (8 free)
+    free = state.nodes.free.at[0, apis.RESOURCE_ACCEL].set(2.0)
+    req = jnp.asarray([[1.0, 1.0, 1.0]])
+    fit = jnp.asarray([[True, True] + [False] * (state.nodes.n - 2)])
+    pack = scoring.placement_score(
+        state.nodes, free, req, fit, scoring.PlacementConfig(binpack_accel=True))
+    spread = scoring.placement_score(
+        state.nodes, free, req, fit, scoring.PlacementConfig(binpack_accel=False))
+    p, s = np.asarray(pack)[0], np.asarray(spread)[0]
+    assert p[0] > p[1]
+    assert s[1] > s[0]
+    assert p.max() == scoring.MAX_HIGH_DENSITY
+
+
+def test_score_bands_compose():
+    """Availability band must dominate any density difference
+    (scores.go band ordering)."""
+    state, _ = small_state(num_nodes=2, node_accel=8.0)
+    req = jnp.asarray([[1.0, 1.0, 1.0]])
+    fit_pipe = jnp.asarray([[True, True] + [False] * (state.nodes.n - 2)])
+    fit_idle = jnp.asarray([[False, True] + [False] * (state.nodes.n - 2)])
+    total = scoring.score_nodes_for_task(
+        state.nodes, state.nodes.free, req, fit_idle, fit_pipe)
+    t = np.asarray(total)[0]
+    assert t[1] > t[0]                      # idle-fitting node wins
+    assert t[2] <= scoring.BIG_NEG          # infeasible masked off
+
+
+def test_cpu_only_task_prefers_cpu_node():
+    nodes = [
+        apis.Node("gpu", apis.ResourceVec(8, 32, 128)),
+        apis.Node("cpu", apis.ResourceVec(0, 32, 128)),
+    ]
+    queues = [apis.Queue("q")]
+    state, idx = build_snapshot(nodes, queues, [], [])
+    req = jnp.asarray([[0.0, 4.0, 8.0]])
+    s = scoring.resource_type_score(state.nodes, req)
+    arr = np.asarray(s)[0]
+    assert arr[idx.node_index("cpu")] == scoring.W_RESOURCE_TYPE
+    assert arr[idx.node_index("gpu")] == 0.0
