@@ -1,0 +1,74 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures the full compiled scheduling step (DRF division + gang-allocate
+scan) at BASELINE.json config-3 scale by default (2k nodes, 1k gangs × 8
+pods — the gang all-or-nothing benchmark).  Override with env vars
+BENCH_NODES / BENCH_GANGS / BENCH_TASKS / BENCH_ITERS.
+
+``vs_baseline``: the reference publishes no absolute numbers
+(BASELINE.md); its implied budget is the default 1 s schedule-period a
+cycle must fit in (``cmd/scheduler/app/options/options.go:33``).  We
+report p99 cycle latency and set ``vs_baseline = 1000 ms / p99 ms`` —
+how many reference cycle budgets fit in one of ours (higher is better).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    num_nodes = int(os.environ.get("BENCH_NODES", 200 if quick else 2000))
+    num_gangs = int(os.environ.get("BENCH_GANGS", 100 if quick else 1000))
+    tasks = int(os.environ.get("BENCH_TASKS", 4 if quick else 8))
+    iters = int(os.environ.get("BENCH_ITERS", 3 if quick else 20))
+
+    from kai_scheduler_tpu.ops import drf
+    from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+    from kai_scheduler_tpu.state import build_snapshot, make_cluster
+
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=num_nodes, node_accel=8.0, node_cpu=256.0, node_mem=1024.0,
+        num_gangs=num_gangs, tasks_per_gang=tasks,
+        num_departments=4, queues_per_department=4)
+    state, _ = build_snapshot(nodes, queues, groups, pods, topo)
+
+    num_levels = 2
+    config = AllocateConfig(dynamic_order=False)
+
+    @jax.jit
+    def cycle(state):
+        fair_share = drf.set_fair_share(state, num_levels=num_levels)
+        st = state.replace(queues=state.queues.replace(fair_share=fair_share))
+        res = allocate(st, fair_share, num_levels=num_levels, config=config)
+        return res.placements, res.allocated
+
+    # compile (excluded from timing, like the reference's warm informer cache)
+    placements, allocated = jax.block_until_ready(cycle(state))
+    placed_pods = int((np.asarray(placements) >= 0).sum())
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(cycle(state))
+        times.append(time.perf_counter() - t0)
+    p99_ms = float(np.percentile(np.asarray(times), 99) * 1e3)
+
+    print(json.dumps({
+        "metric": (f"sched-cycle p99 latency ({num_nodes} nodes x "
+                   f"{num_gangs} gangs x {tasks} pods, "
+                   f"{placed_pods} pods placed)"),
+        "value": round(p99_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(1000.0 / max(p99_ms, 1e-9), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .binder import Binder, BinderPlugin, BindResult, GpuSharingPlugin
+
+__all__ = ["Binder", "BinderPlugin", "BindResult", "GpuSharingPlugin"]

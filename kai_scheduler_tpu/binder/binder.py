@@ -1,0 +1,111 @@
+"""Binder — consumes BindRequests and commits pod→node bindings.
+
+Reference: a separate controller process (``pkg/binder``) watching
+BindRequest CRs; per request it runs a PreBind plugin chain (volume
+binding, DRA claims, GPU-sharing env injection), calls the
+``pods/binding`` subresource, and on failure rolls back and retries up
+to ``BackoffLimit`` (``binder/controllers/bindrequest_controller.go:55``,
+``binder/binding/binder.go:34-130``).
+
+Here the binder is an in-process reconciler over ``Cluster``: the plugin
+chain is the same Name/PreBind/PostBind/Rollback protocol
+(``binder/plugins/interface.go:16-24``), and async-ness is modeled by
+processing whatever requests exist when ``reconcile`` runs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+
+
+class BinderPlugin(Protocol):
+    """ref ``binder/plugins/interface.go:16-24``."""
+
+    name: str
+
+    def pre_bind(self, cluster: Cluster, pod: apis.Pod,
+                 request: apis.BindRequest) -> None: ...
+
+    def post_bind(self, cluster: Cluster, pod: apis.Pod,
+                  request: apis.BindRequest) -> None: ...
+
+    def rollback(self, cluster: Cluster, pod: apis.Pod,
+                 request: apis.BindRequest) -> None: ...
+
+
+@dataclasses.dataclass
+class GpuSharingPlugin:
+    """Fractional-accelerator bind support.
+
+    The reference's gpusharing binder plugin injects visible-device env
+    vars resolved through a reservation pod per shared GPU group
+    (``binder/binding/resourcereservation/``).  TPU-native equivalent:
+    fractional tasks are tagged with their device *group* so the runtime
+    can map them onto the same chip; no reservation round-trip is needed
+    because assignment is decided by the scheduler's device-group tensor.
+    """
+
+    name: str = "gpusharing"
+    _saved_portions: dict = dataclasses.field(default_factory=dict)
+
+    def pre_bind(self, cluster, pod, request):
+        if request.received_resource_type == apis.ReceivedResourceType.FRACTION:
+            self._saved_portions[pod.name] = pod.accel_portion
+            pod.accel_portion = request.received_accel_portion or pod.accel_portion
+
+    def post_bind(self, cluster, pod, request):
+        self._saved_portions.pop(pod.name, None)
+
+    def rollback(self, cluster, pod, request):
+        if pod.name in self._saved_portions:
+            pod.accel_portion = self._saved_portions.pop(pod.name)
+
+
+@dataclasses.dataclass
+class BindResult:
+    bound: list[str] = dataclasses.field(default_factory=list)
+    failed: list[str] = dataclasses.field(default_factory=list)
+    retrying: list[str] = dataclasses.field(default_factory=list)
+
+
+class Binder:
+    """BindRequest reconciler with backoff."""
+
+    def __init__(self, plugins: list[BinderPlugin] | None = None):
+        self.plugins = plugins if plugins is not None else [GpuSharingPlugin()]
+
+    def reconcile(self, cluster: Cluster) -> BindResult:
+        """Process all pending BindRequests once (one controller sweep)."""
+        result = BindResult()
+        for br in list(cluster.bind_requests.values()):
+            if br.phase != "Pending":
+                continue
+            pod = cluster.pods.get(br.pod_name)
+            if pod is None or pod.status != apis.PodStatus.PENDING:
+                br.phase = "Failed"
+                result.failed.append(br.pod_name)
+                continue
+            done: list[BinderPlugin] = []
+            try:
+                for plugin in self.plugins:
+                    plugin.pre_bind(cluster, pod, br)
+                    done.append(plugin)
+                cluster.bind_pod(br.pod_name, br.selected_node)
+            except Exception:
+                for plugin in reversed(done):
+                    plugin.rollback(cluster, pod, br)
+                br.failures += 1
+                if br.failures > br.backoff_limit:
+                    br.phase = "Failed"
+                    result.failed.append(br.pod_name)
+                else:
+                    result.retrying.append(br.pod_name)
+                continue
+            for plugin in self.plugins:
+                plugin.post_bind(cluster, pod, br)
+            br.phase = "Succeeded"
+            result.bound.append(br.pod_name)
+        return result

@@ -1,0 +1,102 @@
+"""Cycle driver — ``scheduler.go`` ``Scheduler.Run``/``runOnce`` rebuilt.
+
+The reference loop (``pkg/scheduler/scheduler.go:109-170``): every
+``schedulePeriod`` open a session (snapshot + plugin init), execute the
+configured action pipeline (default ``allocate, consolidation, reclaim,
+preempt, stalegangeviction``), close the session (flush status).  The
+TPU rebuild keeps that exact shape; each action is a host function that
+invokes one compiled kernel and merges its commit set.
+
+Actions register by name (ref ``actions/factory.go:31-37``
+RegisterAction) so configuration strings select and order them the same
+way ``SchedulerConfiguration.Actions`` does.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Protocol
+
+from ..apis import types as apis
+from ..ops.allocate import allocate_jit
+from ..runtime.cluster import Cluster
+from .session import Session, SessionConfig
+
+
+@dataclasses.dataclass
+class CycleResult:
+    """Everything one ``runOnce`` decided (the Statement commit set)."""
+
+    bind_requests: list[apis.BindRequest] = dataclasses.field(default_factory=list)
+    evictions: list[apis.Eviction] = dataclasses.field(default_factory=list)
+    #: action name -> wall seconds (ref per-action latency metrics)
+    action_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
+    session_seconds: float = 0.0
+
+
+class Action(Protocol):
+    """An action mutates the cycle's commit set — ref ``framework/interface.go``."""
+
+    def __call__(self, session: Session, result: CycleResult) -> None: ...
+
+
+_ACTION_REGISTRY: dict[str, Callable[[], Action]] = {}
+
+
+def register_action(name: str):
+    """ref ``framework.RegisterAction`` (``actions/factory.go:31-37``)."""
+    def deco(builder: Callable[[], Action]):
+        _ACTION_REGISTRY[name] = builder
+        return builder
+    return deco
+
+
+def action_names() -> list[str]:
+    return list(_ACTION_REGISTRY)
+
+
+@register_action("allocate")
+def _allocate_action() -> Action:
+    def run(session: Session, result: CycleResult) -> None:
+        alloc = allocate_jit(
+            session.state, session.state.queues.fair_share,
+            num_levels=session.config.num_levels,
+            config=session.config.allocate)
+        result.bind_requests.extend(session.bind_requests_from(alloc))
+    return run
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """ref ``conf/scheduler_conf.go:49-62`` SchedulerConfiguration."""
+
+    actions: tuple[str, ...] = ("allocate",)
+    session: SessionConfig = dataclasses.field(default_factory=SessionConfig)
+    schedule_period_s: float = 1.0
+
+
+class Scheduler:
+    """The cycle driver.  One instance per SchedulingShard."""
+
+    def __init__(self, config: SchedulerConfig | None = None):
+        self.config = config or SchedulerConfig()
+        self._actions: list[tuple[str, Action]] = [
+            (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
+
+    def run_once(self, cluster: Cluster) -> CycleResult:
+        """One scheduling cycle: snapshot → actions → commit set."""
+        t0 = time.perf_counter()
+        session = Session.open(
+            *cluster.snapshot_lists(), config=self.config.session)
+        result = CycleResult()
+        for name, action in self._actions:
+            ta = time.perf_counter()
+            action(session, result)
+            result.action_seconds[name] = time.perf_counter() - ta
+        # commit: write BindRequests + evictions back through the API hub
+        for br in result.bind_requests:
+            cluster.create_bind_request(br)
+        for ev in result.evictions:
+            cluster.evict_pod(ev.pod_name)
+        result.session_seconds = time.perf_counter() - t0
+        return result

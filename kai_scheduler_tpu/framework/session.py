@@ -1,0 +1,110 @@
+"""Session — the per-cycle unit of work.
+
+Reference: ``framework/framework.go:33-79`` OpenSession builds a snapshot
+and lets every plugin register callbacks on it; actions then drive the
+cycle through those callbacks and a Statement transaction log, and
+CloseSession flushes status.  Here the Session is a *value*: the
+tensorized snapshot plus the solver outputs, and "commit" is a pure
+translation from placement tensors back to BindRequest/Eviction objects
+via the SnapshotIndex (the reverse of ``build_snapshot``).
+
+The Statement's checkpoint/rollback machinery lives *inside* the
+compiled kernels (functional state selection, see ``ops/allocate.py``);
+by the time tensors reach the Session they are already committed in the
+transactional sense — this mirrors how the reference only materializes
+BindRequests at ``Statement.Commit`` (``framework/statement.go``).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..apis import types as apis
+from ..ops import drf
+from ..ops.allocate import AllocateConfig, AllocationResult
+from ..state.cluster_state import ClusterState, SnapshotIndex, build_snapshot
+
+
+@dataclasses.dataclass
+class SessionConfig:
+    """Cycle-level knobs (ref ``conf/scheduler_conf.go`` SchedulerConfiguration)."""
+
+    allocate: AllocateConfig = dataclasses.field(default_factory=AllocateConfig)
+    #: queue-hierarchy depth for fair-share recursion / capacity walks
+    num_levels: int = 2
+    #: proportion plugin kValue (time-based fairshare coupling)
+    k_value: float = 0.0
+    default_bind_backoff_limit: int = 3
+
+
+@dataclasses.dataclass
+class Session:
+    """One cycle's snapshot + derived tensors."""
+
+    state: ClusterState
+    index: SnapshotIndex
+    config: SessionConfig
+
+    @classmethod
+    def open(
+        cls,
+        nodes: list[apis.Node],
+        queues: list[apis.Queue],
+        pod_groups: list[apis.PodGroup],
+        pods: list[apis.Pod],
+        topology: apis.Topology | None = None,
+        config: SessionConfig | None = None,
+        **snapshot_kwargs,
+    ) -> "Session":
+        """OpenSession: snapshot + proportion plugin share division."""
+        config = config or SessionConfig()
+        state, index = build_snapshot(
+            nodes, queues, pod_groups, pods, topology, **snapshot_kwargs)
+        fair_share = drf.set_fair_share(
+            state, num_levels=config.num_levels, k_value=config.k_value)
+        state = state.replace(queues=state.queues.replace(fair_share=fair_share))
+        return cls(state=state, index=index, config=config)
+
+    # -- commit path ------------------------------------------------------
+
+    def bind_requests_from(self, result: AllocationResult) -> list[apis.BindRequest]:
+        """Placement tensors → BindRequest objects (``cache.Bind`` analogue).
+
+        Only gangs with ``allocated=True`` produce requests — the kernels
+        guarantee those rows are internally consistent (all-or-nothing).
+        """
+        placements = np.asarray(result.placements)
+        allocated = np.asarray(result.allocated)
+        portions = np.asarray(self.state.gangs.task_portion)
+        out: list[apis.BindRequest] = []
+        for gi, gang_name in enumerate(self.index.gang_names):
+            if not allocated[gi]:
+                continue
+            for ti, pod_name in enumerate(self.index.task_names[gi]):
+                node = int(placements[gi, ti])
+                if pod_name is None or node < 0:
+                    continue
+                portion = float(portions[gi, ti])
+                out.append(apis.BindRequest(
+                    pod_name=pod_name,
+                    selected_node=self.index.node_names[node],
+                    received_resource_type=(
+                        apis.ReceivedResourceType.FRACTION if portion > 0
+                        else apis.ReceivedResourceType.REGULAR),
+                    received_accel_portion=portion,
+                    backoff_limit=self.config.default_bind_backoff_limit,
+                ))
+        return out
+
+    def evictions_from(self, victim_mask) -> list[apis.Eviction]:
+        """Victim tensor [M] → Eviction objects (``cache.Evict`` analogue)."""
+        mask = np.asarray(victim_mask)
+        gangs = np.asarray(self.state.running.gang)
+        out: list[apis.Eviction] = []
+        for mi, name in enumerate(self.index.running_pod_names):
+            if mi < len(mask) and mask[mi] and name:
+                gi = int(gangs[mi])
+                group = self.index.gang_names[gi] if 0 <= gi < len(self.index.gang_names) else ""
+                out.append(apis.Eviction(pod_name=name, group=group))
+        return out

@@ -1,0 +1,5 @@
+from .mesh import (NODE_AXIS, make_mesh, node_sharding, replicated,
+                   shard_state, state_shardings)
+
+__all__ = ["NODE_AXIS", "make_mesh", "node_sharding", "replicated",
+           "shard_state", "state_shardings"]

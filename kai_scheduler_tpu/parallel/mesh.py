@@ -1,0 +1,65 @@
+"""Mesh + sharding layout — scale the *node axis* across TPU devices.
+
+The reference scales by sharding the cluster across scheduler instances
+(SchedulingShard CRD, one process per node-pool partition) and by
+goroutine fan-out over nodes inside a cycle (``framework/session.go:234``).
+The TPU equivalent (SURVEY.md §2.9): one logical scheduler whose
+node-axis tensors are sharded over a ``jax.sharding.Mesh``; XLA inserts
+the ICI collectives (the argmax/any reductions over nodes become
+AllReduce) — scoring all nodes in parallel the way goroutines never
+could.  DCN multi-slice would add an outer mesh axis; out of scope for
+the solver itself.
+
+Design note: the per-gang scan stays sequential (job order is semantics,
+SURVEY.md §7 hard-part 1); only the node dimension is spatial.  Queue,
+gang, and running-pod tensors are replicated — they are tiny next to
+[N, R] and [N, K] at 10k nodes.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..state.cluster_state import ClusterState
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: list | None = None, axis: str = NODE_AXIS) -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (axis,))
+
+
+def node_sharding(mesh: Mesh, axis: str = NODE_AXIS) -> NamedSharding:
+    """Shard dim 0 (the node axis), replicate trailing dims."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def state_shardings(state: ClusterState, mesh: Mesh, axis: str = NODE_AXIS):
+    """A ClusterState-shaped pytree of NamedShardings: node-axis arrays
+    sharded over the mesh, everything else replicated."""
+    shard = node_sharding(mesh, axis)
+    repl = replicated(mesh)
+    return jax.tree.map(lambda _: repl, state).replace(
+        nodes=jax.tree.map(lambda _: shard, state.nodes))
+
+
+def shard_state(state: ClusterState, mesh: Mesh, axis: str = NODE_AXIS) -> ClusterState:
+    """Place a host snapshot onto the mesh with the framework layout.
+
+    Requires the padded node axis to divide the mesh size —
+    ``build_snapshot(pad=...)`` already rounds up; pass
+    ``pad=mesh.size`` (or a multiple) when building snapshots destined
+    for a mesh.
+    """
+    n = state.nodes.valid.shape[0]
+    if n % mesh.size != 0:
+        raise ValueError(
+            f"node axis {n} not divisible by mesh size {mesh.size}; "
+            f"build the snapshot with pad={mesh.size}")
+    return jax.device_put(state, state_shardings(state, mesh, axis))

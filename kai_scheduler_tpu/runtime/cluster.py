@@ -1,0 +1,123 @@
+"""In-memory cluster model — the framework's stand-in for the K8s API server.
+
+Every reference component talks exclusively through the API server (CRDs
++ watches, SURVEY.md §1); this object is that hub for the TPU framework:
+intake (podgrouper) writes PodGroups into it, the scheduler snapshots it,
+the binder commits bindings back, controllers (queue/podgroup status)
+derive status from it.  In a real deployment this is replaced by a thin
+client layer; the scheduling semantics live entirely above it.
+
+It deliberately mirrors the fake-cluster model the reference uses for its
+action integration tests (``pkg/scheduler/test_utils/test_utils.go``) —
+the same object doubles as the test harness, per SURVEY.md §4 tier 2.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..apis import types as apis
+
+
+@dataclasses.dataclass
+class Cluster:
+    """Mutable cluster document store, keyed by object name."""
+
+    nodes: dict[str, apis.Node] = dataclasses.field(default_factory=dict)
+    queues: dict[str, apis.Queue] = dataclasses.field(default_factory=dict)
+    pod_groups: dict[str, apis.PodGroup] = dataclasses.field(default_factory=dict)
+    pods: dict[str, apis.Pod] = dataclasses.field(default_factory=dict)
+    topology: apis.Topology | None = None
+    bind_requests: dict[str, apis.BindRequest] = dataclasses.field(default_factory=dict)
+    #: monotonic clock advanced by the simulation driver
+    now: float = 0.0
+
+    # -- intake -----------------------------------------------------------
+
+    @classmethod
+    def from_objects(cls, nodes, queues, pod_groups, pods, topology=None) -> "Cluster":
+        c = cls(topology=topology)
+        for n in nodes:
+            c.nodes[n.name] = n
+        for q in queues:
+            c.queues[q.name] = q
+        for g in pod_groups:
+            c.pod_groups[g.name] = g
+        for p in pods:
+            c.pods[p.name] = p
+        return c
+
+    def submit(self, group: apis.PodGroup, pods: list[apis.Pod]) -> None:
+        """Add a workload (PodGroup + its pods) — podgrouper output."""
+        group.creation_timestamp = group.creation_timestamp or self.now
+        self.pod_groups[group.name] = group
+        for p in pods:
+            p.creation_timestamp = p.creation_timestamp or self.now
+            self.pods[p.name] = p
+
+    # -- views ------------------------------------------------------------
+
+    def snapshot_lists(self):
+        """Stable-ordered object lists for ``build_snapshot``.
+
+        Pods with an in-flight (Pending) BindRequest are presented as
+        BOUND on their selected node — the reference's snapshot does the
+        same (``cache/cluster_info/cluster_info.go:323`` snapshotBindRequests)
+        so the scheduler neither double-allocates their capacity nor
+        re-schedules them while the binder retries.
+        """
+        pods: list[apis.Pod] = []
+        for p in self.pods.values():
+            br = self.bind_requests.get(p.name)
+            if (p.status == apis.PodStatus.PENDING and br is not None
+                    and br.phase == "Pending"):
+                pods.append(dataclasses.replace(
+                    p, status=apis.PodStatus.BOUND, node=br.selected_node))
+            else:
+                pods.append(p)
+        return (
+            list(self.nodes.values()),
+            list(self.queues.values()),
+            list(self.pod_groups.values()),
+            pods,
+            self.topology,
+        )
+
+    def pods_of_group(self, group: str) -> list[apis.Pod]:
+        return [p for p in self.pods.values() if p.group == group]
+
+    def group_running_count(self, group: str) -> int:
+        return sum(p.status in (apis.PodStatus.BOUND, apis.PodStatus.RUNNING)
+                   for p in self.pods_of_group(group))
+
+    # -- commit side (binder / evictor write-backs) -----------------------
+
+    def create_bind_request(self, br: apis.BindRequest) -> None:
+        self.bind_requests[br.pod_name] = br
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        """pods/binding subresource equivalent."""
+        pod = self.pods[pod_name]
+        if node_name not in self.nodes:
+            raise KeyError(f"node {node_name} not found")
+        pod.node = node_name
+        pod.status = apis.PodStatus.BOUND
+        group = self.pod_groups.get(pod.group)
+        if group is not None and group.last_start_timestamp is None:
+            group.last_start_timestamp = self.now
+
+    def evict_pod(self, pod_name: str) -> None:
+        """Eviction = delete pod; its resources become releasing until the
+        next tick reaps it (matching the reference's deletion grace window)."""
+        pod = self.pods.get(pod_name)
+        if pod is not None:
+            pod.status = apis.PodStatus.RELEASING
+
+    def tick(self, seconds: float = 1.0) -> None:
+        """Advance time: bound pods start running, releasing pods vanish."""
+        self.now += seconds
+        for name in list(self.pods):
+            pod = self.pods[name]
+            if pod.status == apis.PodStatus.RELEASING:
+                del self.pods[name]
+            elif pod.status == apis.PodStatus.BOUND:
+                pod.status = apis.PodStatus.RUNNING

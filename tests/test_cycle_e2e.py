@@ -1,0 +1,111 @@
+"""End-to-end cycle tests: Cluster → Scheduler.run_once → Binder.reconcile.
+
+Analogue of the reference's action integration suites
+(``actions/integration_tests/``) and the envtest component tests
+(``pkg/env-tests``), on the in-memory Cluster hub.
+"""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.binder import Binder
+from kai_scheduler_tpu.framework import Scheduler, SchedulerConfig
+from kai_scheduler_tpu.runtime import Cluster
+from kai_scheduler_tpu.state import make_cluster
+
+
+def build(**kw) -> Cluster:
+    nodes, queues, groups, pods, topo = make_cluster(**kw)
+    return Cluster.from_objects(nodes, queues, groups, pods, topo)
+
+
+def test_full_cycle_binds_pods():
+    cluster = build(num_nodes=4, node_accel=8.0, num_gangs=4, tasks_per_gang=2)
+    sched, binder = Scheduler(), Binder()
+    result = sched.run_once(cluster)
+    assert len(result.bind_requests) == 8
+    bind = binder.reconcile(cluster)
+    assert len(bind.bound) == 8
+    assert all(p.status == apis.PodStatus.BOUND
+               for p in cluster.pods.values())
+    assert all(p.node is not None for p in cluster.pods.values())
+
+
+def test_cycle_is_idempotent_when_everything_bound():
+    cluster = build(num_nodes=4, num_gangs=4, tasks_per_gang=2)
+    sched, binder = Scheduler(), Binder()
+    sched.run_once(cluster)
+    binder.reconcile(cluster)
+    cluster.tick()
+    result2 = sched.run_once(cluster)
+    assert result2.bind_requests == []
+
+
+def test_pending_backlog_drains_over_cycles():
+    """Demand 2x capacity: first cycle fills the cluster; once running
+    gangs finish, the next cycles place the rest."""
+    cluster = build(num_nodes=2, node_accel=4.0, node_cpu=1000.0,
+                    node_mem=1000.0, num_gangs=8, tasks_per_gang=2)
+    sched, binder = Scheduler(), Binder()
+    sched.run_once(cluster)
+    bound_first = len(binder.reconcile(cluster).bound)
+    assert bound_first == 8  # 8 accel capacity / 1 accel per pod
+    # finish the first wave
+    for p in cluster.pods.values():
+        if p.status == apis.PodStatus.BOUND:
+            p.status = apis.PodStatus.SUCCEEDED
+    sched.run_once(cluster)
+    bound_second = len(binder.reconcile(cluster).bound)
+    assert bound_second == 8
+
+
+def test_binder_backoff_on_missing_node():
+    cluster = build(num_nodes=2, num_gangs=1, tasks_per_gang=1)
+    sched, binder = Scheduler(), Binder()
+    result = sched.run_once(cluster)
+    assert len(result.bind_requests) == 1
+    # sabotage: node disappears between scheduling and binding
+    br = result.bind_requests[0]
+    del cluster.nodes[br.selected_node]
+    bind = binder.reconcile(cluster)
+    assert bind.retrying == [br.pod_name]
+    assert cluster.bind_requests[br.pod_name].failures == 1
+    assert cluster.pods[br.pod_name].status == apis.PodStatus.PENDING
+
+
+def test_inflight_bindrequest_not_rescheduled():
+    """A pod with a Pending BindRequest must be snapshotted as bound on
+    its selected node: no double-allocation, no clobbered retry counter
+    (ref cache snapshotBindRequests)."""
+    cluster = build(num_nodes=2, num_gangs=1, tasks_per_gang=1)
+    sched = Scheduler()
+    result = sched.run_once(cluster)
+    br = result.bind_requests[0]
+    cluster.bind_requests[br.pod_name].failures = 2
+    # binder has NOT run yet — next cycle must not re-schedule the pod
+    result2 = sched.run_once(cluster)
+    assert result2.bind_requests == []
+    assert cluster.bind_requests[br.pod_name].failures == 2
+
+
+def test_gang_atomicity_across_the_stack():
+    """A gang that cannot fully fit leaves zero bind requests."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=10))]
+    groups = [apis.PodGroup("gang", queue="q", min_member=3)]
+    pods = [apis.Pod(f"p{i}", "gang", apis.ResourceVec(1, 1, 1))
+            for i in range(3)]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    result = Scheduler().run_once(cluster)
+    assert result.bind_requests == []
+
+
+def test_eviction_flow_releases_then_reaps():
+    cluster = build(num_nodes=2, num_gangs=2, tasks_per_gang=1,
+                    running_fraction=0.5)
+    running = [p for p in cluster.pods.values()
+               if p.status == apis.PodStatus.RUNNING]
+    assert running
+    cluster.evict_pod(running[0].name)
+    assert cluster.pods[running[0].name].status == apis.PodStatus.RELEASING
+    cluster.tick()
+    assert running[0].name not in cluster.pods
