@@ -162,6 +162,16 @@ class SubGroup:
     topology_constraint: TopologyConstraint | None = None
 
 
+class PodGroupPhase(str, enum.Enum):
+    """Ref ``podgroup_types.go`` PodGroupPhase / podgroupcontroller."""
+
+    PENDING = "Pending"
+    SCHEDULED = "Scheduled"
+    RUNNING = "Running"
+    UNSCHEDULABLE = "Unschedulable"
+    STALE = "Stale"          # below minMember after having started
+
+
 @dataclasses.dataclass
 class PodGroup:
     """The gang unit — ref ``podgroup_types.go:34-77``."""
@@ -179,6 +189,11 @@ class PodGroup:
     creation_timestamp: float = 0.0
     #: wall-clock the gang became running (for minruntime protection)
     last_start_timestamp: float | None = None
+    #: status maintained by the podgroup controller
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    #: wall-clock the gang dropped below minMember while started — feeds
+    #: the stalegangeviction action (ref PodGroupInfo staleness tracking).
+    stale_since: float | None = None
 
 
 # ---------------------------------------------------------------------------

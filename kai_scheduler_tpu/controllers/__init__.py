@@ -1,0 +1,12 @@
+"""Status controllers — the control layer (SURVEY.md §1 layer 4).
+
+Host-side reconcilers over the runtime ``Cluster`` hub, mirroring the
+reference's controller binaries:
+
+- :class:`PodGroupController` — ``pkg/podgroupcontroller``
+- :class:`QueueController`    — ``pkg/queuecontroller``
+"""
+from .podgroup_controller import PodGroupController
+from .queue_controller import QueueController, QueueStatus
+
+__all__ = ["PodGroupController", "QueueController", "QueueStatus"]

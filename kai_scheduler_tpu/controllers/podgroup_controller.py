@@ -1,0 +1,46 @@
+"""PodGroup status controller.
+
+Reference: ``pkg/podgroupcontroller/controllers/pod_group_controller.go:56``
+derives each PodGroup's phase and resource status from its pods.  Here the
+reconciler additionally stamps ``stale_since`` — the staleness signal the
+stalegangeviction action consumes (the reference computes staleness inside
+the scheduler's PodGroupInfo; keeping it on the controller keeps the
+snapshot pure).
+"""
+from __future__ import annotations
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+
+_ACTIVE = (apis.PodStatus.BOUND, apis.PodStatus.RUNNING)
+
+
+class PodGroupController:
+    """Reconciles PodGroup phase + staleness from pod states."""
+
+    def reconcile(self, cluster: Cluster) -> None:
+        for group in cluster.pod_groups.values():
+            pods = cluster.pods_of_group(group.name)
+            active = sum(p.status in _ACTIVE for p in pods)
+            running = sum(p.status == apis.PodStatus.RUNNING for p in pods)
+
+            attained = group.phase in (apis.PodGroupPhase.SCHEDULED,
+                                       apis.PodGroupPhase.RUNNING,
+                                       apis.PodGroupPhase.STALE)
+            if active >= max(group.min_member, 1):
+                if group.last_start_timestamp is None:
+                    group.last_start_timestamp = cluster.now
+                group.stale_since = None
+                group.phase = (apis.PodGroupPhase.RUNNING if running
+                               else apis.PodGroupPhase.SCHEDULED)
+            elif attained and active > 0:
+                # reached minMember before, then lost pods: stale.  A gang
+                # still scaling toward its first quorum is NOT stale
+                # (last_start_timestamp alone is stamped at first bind and
+                # must not trigger staleness).
+                if group.stale_since is None:
+                    group.stale_since = cluster.now
+                group.phase = apis.PodGroupPhase.STALE
+            else:
+                group.stale_since = None
+                group.phase = apis.PodGroupPhase.PENDING

@@ -1,0 +1,71 @@
+"""Queue status controller.
+
+Reference: ``pkg/queuecontroller/controllers/queue_controller.go:51``
+maintains each Queue's status — ``Allocated`` / ``AllocatedNonPreemptible``
+/ ``Requested`` per resource, rolled up the queue hierarchy — and exports
+the per-queue usage metrics that feed time-based fairshare
+(``pkg/queuecontroller/metrics/metrics.go:33-39``).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+
+_ACTIVE = (apis.PodStatus.BOUND, apis.PodStatus.RUNNING)
+
+
+@dataclasses.dataclass
+class QueueStatus:
+    """Mirror of Queue.status (``queue_types.go`` QueueStatus)."""
+
+    allocated: apis.ResourceVec = dataclasses.field(
+        default_factory=apis.ResourceVec)
+    allocated_non_preemptible: apis.ResourceVec = dataclasses.field(
+        default_factory=apis.ResourceVec)
+    requested: apis.ResourceVec = dataclasses.field(
+        default_factory=apis.ResourceVec)
+
+
+class QueueController:
+    """Derives queue status from pods + pod groups; feeds metrics/usagedb."""
+
+    def reconcile(self, cluster: Cluster) -> dict[str, QueueStatus]:
+        status = {name: QueueStatus() for name in cluster.queues}
+        for group in cluster.pod_groups.values():
+            if group.queue not in status:
+                continue
+            st = status[group.queue]
+            nonpreempt = (group.preemptibility
+                          == apis.Preemptibility.NON_PREEMPTIBLE)
+            for pod in cluster.pods_of_group(group.name):
+                if pod.status in _ACTIVE:
+                    st.allocated = st.allocated + pod.resources
+                    if nonpreempt:
+                        st.allocated_non_preemptible = (
+                            st.allocated_non_preemptible + pod.resources)
+                    st.requested = st.requested + pod.resources
+                elif pod.status == apis.PodStatus.PENDING:
+                    st.requested = st.requested + pod.resources
+        # roll up the hierarchy (children before parents)
+        order = sorted(
+            cluster.queues.values(),
+            key=lambda q: -self._depth(cluster, q))
+        for q in order:
+            if q.parent and q.parent in status:
+                parent = status[q.parent]
+                child = status[q.name]
+                parent.allocated = parent.allocated + child.allocated
+                parent.allocated_non_preemptible = (
+                    parent.allocated_non_preemptible
+                    + child.allocated_non_preemptible)
+                parent.requested = parent.requested + child.requested
+        return status
+
+    @staticmethod
+    def _depth(cluster: Cluster, q: apis.Queue) -> int:
+        d, cur = 0, q
+        while cur.parent is not None and cur.parent in cluster.queues:
+            d, cur = d + 1, cluster.queues[cur.parent]
+        return d

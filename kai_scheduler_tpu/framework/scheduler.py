@@ -17,10 +17,19 @@ import dataclasses
 import time
 from typing import Callable, Protocol
 
+import functools
+
+import jax
+
 from ..apis import types as apis
-from ..ops.allocate import allocate_jit
+from ..ops.allocate import AllocationResult, allocate_jit, init_result
+from ..ops.stale import stale_gang_eviction
+from ..ops.victims import run_victim_action_jit
 from ..runtime.cluster import Cluster
 from .session import Session, SessionConfig
+
+stale_eviction_jit = functools.partial(jax.jit, static_argnames=(
+    "grace_s", "num_levels"))(stale_gang_eviction)
 
 
 @dataclasses.dataclass
@@ -29,6 +38,8 @@ class CycleResult:
 
     bind_requests: list[apis.BindRequest] = dataclasses.field(default_factory=list)
     evictions: list[apis.Eviction] = dataclasses.field(default_factory=list)
+    #: the on-device commit set threaded through the action pipeline
+    tensors: AllocationResult | None = None
     #: action name -> wall seconds (ref per-action latency metrics)
     action_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
     session_seconds: float = 0.0
@@ -58,19 +69,59 @@ def action_names() -> list[str]:
 @register_action("allocate")
 def _allocate_action() -> Action:
     def run(session: Session, result: CycleResult) -> None:
-        alloc = allocate_jit(
+        result.tensors = allocate_jit(
             session.state, session.state.queues.fair_share,
             num_levels=session.config.num_levels,
-            config=session.config.allocate)
-        result.bind_requests.extend(session.bind_requests_from(alloc))
+            config=session.config.allocate,
+            init=result.tensors)
+    return run
+
+
+@register_action("reclaim")
+def _reclaim_action() -> Action:
+    """Cross-queue fairness enforcement — ref ``actions/reclaim``."""
+    def run(session: Session, result: CycleResult) -> None:
+        result.tensors = run_victim_action_jit(
+            session.state, session.state.queues.fair_share, result.tensors,
+            num_levels=session.config.num_levels, reclaim=True,
+            config=session.config.victims)
+    return run
+
+
+@register_action("preempt")
+def _preempt_action() -> Action:
+    """Intra-queue priority preemption — ref ``actions/preempt``."""
+    def run(session: Session, result: CycleResult) -> None:
+        result.tensors = run_victim_action_jit(
+            session.state, session.state.queues.fair_share, result.tensors,
+            num_levels=session.config.num_levels, reclaim=False,
+            config=session.config.victims)
+    return run
+
+
+@register_action("stalegangeviction")
+def _stale_action() -> Action:
+    """Evict gangs below minMember past grace — ref
+    ``actions/stalegangeviction``."""
+    def run(session: Session, result: CycleResult) -> None:
+        result.tensors = stale_eviction_jit(
+            session.state, result.tensors,
+            grace_s=session.config.stale_grace_s,
+            num_levels=session.config.num_levels)
     return run
 
 
 @dataclasses.dataclass
 class SchedulerConfig:
-    """ref ``conf/scheduler_conf.go:49-62`` SchedulerConfiguration."""
+    """ref ``conf/scheduler_conf.go:49-62`` SchedulerConfiguration.
 
-    actions: tuple[str, ...] = ("allocate",)
+    Default action pipeline matches the reference default order
+    (``conf_util/scheduler_conf_util.go:37``) minus the actions not yet
+    implemented.
+    """
+
+    actions: tuple[str, ...] = ("allocate", "reclaim", "preempt",
+                                "stalegangeviction")
     session: SessionConfig = dataclasses.field(default_factory=SessionConfig)
     schedule_period_s: float = 1.0
 
@@ -87,13 +138,17 @@ class Scheduler:
         """One scheduling cycle: snapshot → actions → commit set."""
         t0 = time.perf_counter()
         session = Session.open(
-            *cluster.snapshot_lists(), config=self.config.session)
-        result = CycleResult()
+            *cluster.snapshot_lists(), config=self.config.session,
+            now=cluster.now)
+        result = CycleResult(tensors=init_result(session.state))
         for name, action in self._actions:
             ta = time.perf_counter()
             action(session, result)
             result.action_seconds[name] = time.perf_counter() - ta
-        # commit: write BindRequests + evictions back through the API hub
+        # commit: translate the final tensors into BindRequests/evictions
+        # and write them back through the API hub (Statement.Commit).
+        result.bind_requests = session.bind_requests_from(result.tensors)
+        result.evictions = session.evictions_from(result.tensors.victim)
         for br in result.bind_requests:
             cluster.create_bind_request(br)
         for ev in result.evictions:

@@ -23,6 +23,7 @@ import numpy as np
 from ..apis import types as apis
 from ..ops import drf
 from ..ops.allocate import AllocateConfig, AllocationResult
+from ..ops.victims import VictimConfig
 from ..state.cluster_state import ClusterState, SnapshotIndex, build_snapshot
 
 
@@ -31,11 +32,14 @@ class SessionConfig:
     """Cycle-level knobs (ref ``conf/scheduler_conf.go`` SchedulerConfiguration)."""
 
     allocate: AllocateConfig = dataclasses.field(default_factory=AllocateConfig)
+    victims: VictimConfig = dataclasses.field(default_factory=VictimConfig)
     #: queue-hierarchy depth for fair-share recursion / capacity walks
     num_levels: int = 2
     #: proportion plugin kValue (time-based fairshare coupling)
     k_value: float = 0.0
     default_bind_backoff_limit: int = 3
+    #: stalegangeviction grace period (ref options.go:34, default 60s)
+    stale_grace_s: float = 60.0
 
 
 @dataclasses.dataclass
@@ -73,9 +77,14 @@ class Session:
 
         Only gangs with ``allocated=True`` produce requests — the kernels
         guarantee those rows are internally consistent (all-or-nothing).
+        Pipelined placements (tasks waiting on releasing/victim resources)
+        do NOT bind yet: the reference queues them in the Statement and
+        binds on a later cycle once capacity actually frees
+        (``stmt.Pipeline`` vs ``stmt.Allocate``).
         """
         placements = np.asarray(result.placements)
         allocated = np.asarray(result.allocated)
+        pipelined = np.asarray(result.pipelined)
         portions = np.asarray(self.state.gangs.task_portion)
         out: list[apis.BindRequest] = []
         for gi, gang_name in enumerate(self.index.gang_names):
@@ -83,7 +92,7 @@ class Session:
                 continue
             for ti, pod_name in enumerate(self.index.task_names[gi]):
                 node = int(placements[gi, ti])
-                if pod_name is None or node < 0:
+                if pod_name is None or node < 0 or pipelined[gi, ti]:
                     continue
                 portion = float(portions[gi, ti])
                 out.append(apis.BindRequest(

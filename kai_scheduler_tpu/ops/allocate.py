@@ -53,7 +53,13 @@ EPS = 1e-6
 
 
 class AllocationResult(struct.PyTreeNode):
-    """Outcome tensors of one allocate pass (the Statement commit set)."""
+    """The cycle's running commit set — the Statement, as a value.
+
+    Every action (allocate, reclaim, preempt, consolidation) consumes and
+    produces one of these, mirroring how reference actions share the
+    Session's Statement/snapshot mutations across the per-cycle pipeline
+    (``scheduler.go:158-168``).
+    """
 
     placements: jax.Array     # i32 [G, T]  node index per task, -1 unplaced
     pipelined: jax.Array      # bool [G, T] placed onto releasing resources
@@ -62,6 +68,25 @@ class AllocationResult(struct.PyTreeNode):
     free: jax.Array           # f32 [N, R]  idle+releasing pool after commits
     queue_allocated: jax.Array  # f32 [Q, R]
     queue_allocated_nonpreemptible: jax.Array  # f32 [Q, R]
+    #: running pods evicted this cycle (victims of reclaim/preempt/
+    #: consolidation) — bool [M]
+    victim: jax.Array
+
+
+def init_result(state: ClusterState) -> AllocationResult:
+    """Fresh commit set at cycle start (an empty Statement)."""
+    g, n, q = state.gangs, state.nodes, state.queues
+    G, T = g.g, g.t
+    return AllocationResult(
+        placements=jnp.full((G, T), -1, jnp.int32),
+        pipelined=jnp.zeros((G, T), bool),
+        allocated=jnp.zeros((G,), bool),
+        attempted=jnp.zeros((G,), bool),
+        free=n.free,
+        queue_allocated=q.allocated,
+        queue_allocated_nonpreemptible=q.allocated_nonpreemptible,
+        victim=jnp.zeros((state.running.m,), bool),
+    )
 
 
 def _ancestor_scatter(parent: jax.Array, q: jax.Array, num_levels: int,
@@ -163,7 +188,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
             jnp.asarray(0, jnp.int32))
     free2, qa2, qan2, nodes_t, pipe_t, count = lax.fori_loop(
         0, T, task_body, init)
-    success = count >= g.min_member[gang_idx]
+    # min_needed (not min_member): pods already bound/running count toward
+    # the gang's quorum — elastic scale-up and pipelined-remainder gangs.
+    success = count >= g.min_needed[gang_idx]
     return free2, qa2, qan2, nodes_t, pipe_t, success
 
 
@@ -173,17 +200,21 @@ def allocate(
     *,
     num_levels: int,
     config: AllocateConfig = AllocateConfig(),
+    init: AllocationResult | None = None,
 ) -> AllocationResult:
     """Run the allocate action over every pending gang.
 
     Functional equivalent of ``allocate.Execute`` — jit-compatible; all
     shapes static.  ``num_levels`` bounds the queue-hierarchy depth
-    (snapshot-known static).
+    (snapshot-known static).  ``init`` continues an in-progress cycle
+    (the previous action's commit set).
     """
     g, n, q = state.gangs, state.nodes, state.queues
     G, T = g.g, g.t
     total = state.total_capacity
     steps = G if config.queue_depth is None else min(G, config.queue_depth)
+    if init is None:
+        init = init_result(state)
 
     # Releasing capacity participates in the pool (pipeline placements);
     # the free carry is the *idle* pool and may dip negative by at most
@@ -191,10 +222,12 @@ def allocate(
     static_order = None
     if not config.dynamic_order:
         static_order = ordering.static_job_order(
-            g, q, q.allocated, fair_share, total)
+            g, q, init.queue_allocated, fair_share, total)
 
     def step(carry, step_idx):
-        free, qa, qan, remaining, placements, pipelined, allocated, attempted = carry
+        res, remaining = carry
+        free, qa, qan = (res.free, res.queue_allocated,
+                         res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
             gi = ordering.select_next_gang(g, q, qa, fair_share, total, remaining)
         else:
@@ -219,34 +252,27 @@ def allocate(
 
         free, qa, qan, nodes_t, pipe_t, success = lax.cond(
             runnable, attempt, skip, (free, qa, qan))
-        placements = placements.at[gi].set(
-            jnp.where(runnable, nodes_t, placements[gi]))
-        pipelined = pipelined.at[gi].set(
-            jnp.where(runnable, pipe_t, pipelined[gi]))
-        allocated = allocated.at[gi].set(allocated[gi] | success)
-        attempted = attempted.at[gi].set(attempted[gi] | runnable)
+        res = res.replace(
+            free=free, queue_allocated=qa,
+            queue_allocated_nonpreemptible=qan,
+            placements=res.placements.at[gi].set(
+                jnp.where(runnable, nodes_t, res.placements[gi])),
+            pipelined=res.pipelined.at[gi].set(
+                jnp.where(runnable, pipe_t, res.pipelined[gi])),
+            allocated=res.allocated.at[gi].set(res.allocated[gi] | success),
+            attempted=res.attempted.at[gi].set(res.attempted[gi] | runnable),
+        )
         remaining = remaining.at[gi].set(False)
-        return (free, qa, qan, remaining, placements, pipelined,
-                allocated, attempted), None
+        return (res, remaining), None
 
-    init = (
-        n.free, q.allocated, q.allocated_nonpreemptible,
-        g.valid & (g.backoff <= 0),
-        jnp.full((G, T), -1, jnp.int32),
-        jnp.zeros((G, T), bool),
-        jnp.zeros((G,), bool),
-        jnp.zeros((G,), bool),
-    )
-    (free, qa, qan, _, placements, pipelined, allocated, attempted), _ = lax.scan(
-        step, init, jnp.arange(steps))
-    return AllocationResult(
-        placements=placements, pipelined=pipelined, allocated=allocated,
-        attempted=attempted, free=free, queue_allocated=qa,
-        queue_allocated_nonpreemptible=qan)
+    remaining0 = g.valid & (g.backoff <= 0) & ~init.allocated
+    (res, _), _ = lax.scan(step, (init, remaining0), jnp.arange(steps))
+    return res
 
 
 @functools.partial(jax.jit, static_argnames=("num_levels", "config"))
 def allocate_jit(state: ClusterState, fair_share: jax.Array, *,
-                 num_levels: int, config: AllocateConfig = AllocateConfig()
-                 ) -> AllocationResult:
-    return allocate(state, fair_share, num_levels=num_levels, config=config)
+                 num_levels: int, config: AllocateConfig = AllocateConfig(),
+                 init: AllocationResult | None = None) -> AllocationResult:
+    return allocate(state, fair_share, num_levels=num_levels, config=config,
+                    init=init)

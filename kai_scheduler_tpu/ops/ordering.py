@@ -75,7 +75,8 @@ def select_next_gang(
         queues, queue_allocated, fair_share, total)
     qi = gangs.queue
     not_rem = (~remaining).astype(jnp.float32)
-    below_min = jnp.sum(gangs.task_valid, axis=-1) < gangs.min_member
+    # elastic plugin: gangs whose *active* pods are below minMember first
+    below_min = gangs.running_count < gangs.min_member
     # lexsort: LAST key is most significant.
     order = jnp.lexsort((
         gangs.creation_order.astype(jnp.float32),
@@ -104,7 +105,7 @@ def static_job_order(
     over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
         queues, queue_allocated, fair_share, total)
     qi = gangs.queue
-    below_min = jnp.sum(gangs.task_valid, axis=-1) < gangs.min_member
+    below_min = gangs.running_count < gangs.min_member
     return jnp.lexsort((
         gangs.creation_order.astype(jnp.float32),
         -gangs.priority.astype(jnp.float32),

@@ -1,0 +1,59 @@
+"""stalegangeviction — evict gangs that fell below minMember.
+
+Reference (``actions/stalegangeviction/stalegangeviction.go:29-60``): a
+gang whose active pod count dropped under ``minMember`` after it started
+(pods failed / were deleted) is given a staleness grace period (default
+60s, ``cmd/scheduler/app/options/options.go:34``); past it, the whole
+remaining gang is evicted so its resources return to the pool and the
+group can be rescheduled atomically.
+
+Staleness bookkeeping is host-side (the podgroup controller stamps
+``PodGroup.stale_since``); the snapshot carries per-gang ``stale_s`` and
+``running_count`` so the decision itself is one broadcast expression.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state.cluster_state import ClusterState
+from .allocate import AllocationResult
+
+
+def stale_gangs(state: ClusterState, grace_s: float) -> jax.Array:
+    """bool [G] — gangs to evict wholesale this cycle."""
+    g = state.gangs
+    return ((g.stale_s >= grace_s)
+            & (g.running_count > 0)
+            & (g.running_count < g.min_member))
+
+
+def stale_gang_eviction(
+    state: ClusterState,
+    result: AllocationResult,
+    *,
+    grace_s: float = 60.0,
+    num_levels: int = 2,
+) -> AllocationResult:
+    """Mark every surviving pod of a stale gang as a victim and return
+    their resources to the commit set's free pool / queue accounting."""
+    from .victims import _chain_membership, freed_by_mask
+
+    r = state.running
+    G = state.gangs.g
+    stale = stale_gangs(state, grace_s)                       # [G]
+    gang_of_pod = jnp.where(r.gang >= 0, r.gang, G)
+    pod_stale = jnp.concatenate(
+        [stale, jnp.zeros((1,), bool)])[jnp.minimum(gang_of_pod, G)]
+    victims = (r.valid & ~r.releasing & (r.node >= 0) & pod_stale
+               & ~result.victim)
+
+    chain = _chain_membership(state.queues.parent, num_levels)
+    freed_nodes, freed_q, freed_q_np = freed_by_mask(state, victims, chain)
+    return result.replace(
+        victim=result.victim | victims,
+        free=result.free + freed_nodes,
+        queue_allocated=jnp.maximum(result.queue_allocated - freed_q, 0.0),
+        queue_allocated_nonpreemptible=jnp.maximum(
+            result.queue_allocated_nonpreemptible - freed_q_np, 0.0),
+    )

@@ -1,0 +1,447 @@
+"""Victim-scenario engine — reclaim & preempt as compiled scenario search.
+
+Reference (``actions/common/solvers/job_solver.go:47-120``,
+``by_pod_solver.go:20-90``): for a pending *preemptor* gang, grow a victim
+set one eviction unit at a time (``PodAccumulatedScenarioBuilder``), and
+for each scenario simulate "evict victims, re-run allocation" inside a
+Statement; the first scenario whose simulation places the preemptor and
+passes the scenario validators wins.  The eviction *unit*
+(``api/podgroup_info/eviction_info.go:14`` GetTasksToEvict) is a single
+task while the victim gang is elastic (above minMember), then the whole
+remaining gang at once.  The ``idle_gpus`` accumulated filter
+(``accumulated_scenario_filters/idle_gpus.go``) prunes scenarios whose
+freed capacity still cannot fit the preemptor.
+
+TPU-native design: victims are *ranked once* per preemptor — victim jobs
+by a lexsort over gang keys (the ordered victim-queue generator), pods
+within a gang by reverse task order — giving every candidate pod a global
+*unit rank*; a scenario is a unit-rank prefix.  A ``lax.while_loop``
+walks scenarios in order, each iteration:
+
+1. masks pods with ``unit_rank <= k`` and segment-sums their requests
+   into per-node freed capacity (no [scenarios, N, R] materialization),
+2. checks the reclaim strategy for the unit being added (against the
+   leveled queue's remaining share — see below),
+3. runs the same gang-placement kernel the allocate action uses
+   (``_attempt_gang``) on ``free + freed`` — first success wins,
+   mirroring the reference's minimal-victim greedy.
+
+The idle-capacity prefilter fast-forwards ``k`` to the first scenario
+whose aggregate freed + idle covers the preemptor's request.
+
+Validation semantics implemented (see
+``plugins/proportion/reclaimable/reclaimable.go`` and
+``reclaimable/strategies/strategies.go``):
+
+- **CanReclaimResources gate**: reclaimer queue (and ancestors) must stay
+  within fair share after the allocation; a non-preemptible reclaimer's
+  non-preemptible allocation must stay within deserved quota.
+- **Per-eviction strategy** at the *leveled* queue (the victim-side
+  ancestor just below the LCA with the reclaimer —
+  ``reclaimable.go getLeveledQueues``): evictable only while that queue
+  is above fair share (MaintainFairShare) or, when the reclaimer is under
+  deserved quota, above deserved (GuaranteeDeservedQuota) — evaluated
+  against the remaining share before the step, exactly like the
+  reference's running ``remainingResourcesMap``.
+- **Preempt gate** (``actions/preempt/preempt.go:100-110``): a
+  non-preemptible preemptor must keep the queue's non-preemptible
+  allocation within deserved quota.
+- Sibling saturation-order checks degenerate to true under the gate
+  (reclaimer saturation ≤ 1) and are omitted; ``minruntime`` victim
+  protection is a candidate filter here rather than a separate validator.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..apis.types import UNLIMITED
+from ..state.cluster_state import ClusterState
+from . import ordering
+from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
+                       _attempt_gang, init_result)
+
+EPS = 1e-6
+BIG = jnp.int32(2**30)
+
+
+@dataclasses.dataclass(frozen=True)
+class VictimConfig:
+    """Knobs of the victim actions (ref reclaim/preempt action args)."""
+
+    placement: AllocateConfig = AllocateConfig(dynamic_order=False)
+    #: reclaimerSaturationMultiplier (``plugins/proportion/proportion.go:67-95``)
+    saturation_multiplier: float = 1.0
+    #: max preemptor gangs attempted per cycle (QueueDepthPerAction)
+    queue_depth: int | None = None
+
+
+def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
+    """Resources released by evicting the masked running pods.
+
+    Returns (freed_nodes [N, R], freed_queues [Q, R],
+    freed_queues_nonpreemptible [Q, R]) with the queue tensors rolled up
+    the hierarchy via ``chain`` — shared by the victim solver and the
+    stalegangeviction action.
+    """
+    r = state.running
+    n, q = state.nodes, state.queues
+    req_m = jnp.where(mask[:, None], r.req, 0.0)
+    freed_nodes = jax.ops.segment_sum(
+        req_m, jnp.where(mask, jnp.maximum(r.node, 0), n.n),
+        num_segments=n.n + 1)[:n.n]
+    leaf = jax.ops.segment_sum(
+        req_m, jnp.where(mask, jnp.maximum(r.queue, 0), q.q),
+        num_segments=q.q + 1)[:q.q]
+    leaf_np = jax.ops.segment_sum(
+        jnp.where((mask & ~r.preemptible)[:, None], r.req, 0.0),
+        jnp.where(mask & ~r.preemptible, jnp.maximum(r.queue, 0), q.q),
+        num_segments=q.q + 1)[:q.q]
+    chain_f = chain.astype(leaf.dtype)
+    freed_q = jnp.einsum("qa,qr->ar", chain_f, leaf)
+    freed_q_np = jnp.einsum("qa,qr->ar", chain_f, leaf_np)
+    return freed_nodes, freed_q, freed_q_np
+
+
+def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
+    """bool [Q, Q]: ``C[q, a]`` — queue ``a`` is ``q`` or an ancestor of ``q``."""
+    Q = parent.shape[0]
+    eye = jnp.eye(Q, dtype=bool)
+
+    def hop(_, carry):
+        member, cur = carry
+        valid = cur >= 0
+        idx = jnp.maximum(cur, 0)
+        member = member | (valid[:, None] & eye[idx])
+        return member, jnp.where(valid, parent[idx], -1)
+
+    member, _ = lax.fori_loop(
+        0, num_levels, hop, (jnp.zeros((Q, Q), bool), jnp.arange(Q)))
+    return member
+
+
+def victim_candidates(
+    state: ClusterState,
+    gang_idx: jax.Array,
+    *,
+    reclaim: bool,
+    already_victim: jax.Array,   # bool [M]
+) -> jax.Array:
+    """bool [M] — pods eligible as victims for this preemptor.
+
+    Reclaim filter (``actions/reclaim/reclaim.go`` victim generator +
+    ``ReclaimVictimFilter``): preemptible running pods of *other* queues
+    that have run at least their queue's ``reclaimMinRuntime``.
+    Preempt filter (``buildFilterFuncForPreempt``): preemptible running
+    pods of the *same* queue whose gang priority is strictly lower, past
+    ``preemptMinRuntime``.
+    """
+    r = state.running
+    g = state.gangs
+    q = state.queues
+    base = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
+            & (r.gang >= 0) & ~already_victim)
+    my_queue = g.queue[gang_idx]
+    if reclaim:
+        mrt = q.reclaim_min_runtime[jnp.maximum(r.queue, 0)]
+        return base & (r.queue != my_queue) & (r.runtime_s >= mrt)
+    mrt = q.preempt_min_runtime[jnp.maximum(r.queue, 0)]
+    return (base & (r.queue == my_queue)
+            & (r.priority < g.priority[gang_idx])
+            & (r.runtime_s >= mrt))
+
+
+def _rank_eviction_units(
+    state: ClusterState,
+    cand: jax.Array,             # bool [M]
+    queue_allocated: jax.Array,  # f32 [Q, R]
+    fair_share: jax.Array,       # f32 [Q, R]
+):
+    """Assign every candidate pod a global eviction-unit rank.
+
+    Victim *jobs* are ordered by a lexsort over gang keys — the reference
+    generates victims queue-by-queue in reversed queue order (most
+    over-fair-share first) and job-by-job in reversed job order (lowest
+    priority, newest first).  Within a gang, pods are ordered by reverse
+    task order (shortest-running ≈ newest first); each of the first
+    ``allocated - minMember`` pods is its own unit (elastic shrink), the
+    remaining ``minMember`` pods form one final unit
+    (``eviction_info.go GetTasksToEvict``).
+
+    Returns (unit_rank [M] i32 — BIG for non-candidates, num_units []).
+    """
+    g = state.gangs
+    r = state.running
+    G, M = g.g, r.m
+
+    gang_of_pod = jnp.where(cand, r.gang, G)                   # [M], G = junk
+    pods_per_gang = jax.ops.segment_sum(
+        cand.astype(jnp.int32), gang_of_pod, num_segments=G + 1)[:G]
+    victim_gang = pods_per_gang > 0
+
+    # ---- job-level ordering ---------------------------------------------
+    sat = jnp.max(
+        queue_allocated / jnp.maximum(fair_share, EPS), axis=-1)  # [Q]
+    gq = jnp.maximum(g.queue, 0)
+    # lexsort: last key most significant — non-victim gangs last, most
+    # saturated queue first, lowest priority first, newest first.
+    rank_gang = jnp.lexsort((
+        -g.creation_order.astype(jnp.float32),
+        g.priority.astype(jnp.float32),
+        -sat[gq],
+        (~victim_gang).astype(jnp.float32),
+    ))                                                          # [G] gang @ rank
+    job_rank = jnp.zeros((G,), jnp.int32).at[rank_gang].set(
+        jnp.arange(G, dtype=jnp.int32))                         # [G]
+
+    # ---- pod order within gang (reverse task order: newest first) -------
+    perm = jnp.lexsort((r.runtime_s, gang_of_pod))              # [M]
+    pos = jnp.zeros((M,), jnp.int32).at[perm].set(
+        jnp.arange(M, dtype=jnp.int32))
+    first_pos = jax.ops.segment_min(
+        jnp.where(cand, pos, BIG), gang_of_pod, num_segments=G + 1)[:G]
+    seq = pos - first_pos[jnp.minimum(gang_of_pod, G - 1)]      # [M]
+
+    # ---- unit ids --------------------------------------------------------
+    # Surplus is sized from the gang's *active* pod count (running_count),
+    # not the candidate count: pods excluded from candidacy (unknown node,
+    # already victims) still hold the gang above minMember
+    # (ref GetTasksToEvict sizes units from active allocated tasks).
+    surplus = jnp.clip(
+        g.running_count - g.min_member, 0, pods_per_gang)       # [G]
+    units_per_gang = jnp.where(
+        victim_gang, surplus + (pods_per_gang > surplus), 0)    # [G]
+    units_by_rank = units_per_gang[rank_gang]                   # [G]
+    offsets = jnp.cumsum(units_by_rank) - units_by_rank         # [G] excl
+    unit_in_gang = jnp.minimum(seq, surplus[jnp.minimum(gang_of_pod, G - 1)])
+    unit_rank = jnp.where(
+        cand,
+        offsets[job_rank[jnp.minimum(gang_of_pod, G - 1)]] + unit_in_gang,
+        BIG)
+    return unit_rank, jnp.sum(units_per_gang)
+
+
+def _leveled_queue(chain: jax.Array, depth: jax.Array,
+                   vq: jax.Array, rq: jax.Array) -> jax.Array:
+    """The victim-side ancestor just below the LCA with the reclaimer —
+    ref ``reclaimable.go getLeveledQueues``.  i32 scalar queue index."""
+    vchain = chain[vq]                        # bool [Q]
+    rchain = chain[rq]
+    cand_q = vchain & ~rchain
+    d = jnp.where(cand_q, depth, BIG)
+    # -1 when every victim ancestor is shared with the reclaimer (victim
+    # queue is an ancestor of the reclaimer's) — callers treat -1 as
+    # "no leveled queue, strategy check passes".
+    return jnp.where(jnp.any(cand_q), jnp.argmin(d), -1)
+
+
+def solve_for_preemptor(
+    state: ClusterState,
+    gang_idx: jax.Array,
+    result: AllocationResult,
+    fair_share: jax.Array,
+    chain: jax.Array,            # bool [Q, Q]
+    *,
+    num_levels: int,
+    reclaim: bool,
+    config: VictimConfig,
+):
+    """One preemptor's scenario search — returns updated commit-set fields.
+
+    (success, victim_mask [M], task placements [T], pipelined [T],
+    free', qa', qan')
+    """
+    g, q, n, r = state.gangs, state.queues, state.nodes, state.running
+    free = result.free
+    qa = result.queue_allocated
+    qan = result.queue_allocated_nonpreemptible
+    queue = g.queue[gang_idx]
+    task_req = jnp.where(g.task_valid[gang_idx][:, None],
+                         g.task_req[gang_idx], 0.0)
+    total_req = task_req.sum(0)                                # [R]
+    nonpreempt = ~g.preemptible[gang_idx]
+
+    # ---- gates (before any scenario work) -------------------------------
+    nonpreempt_quota_ok = jnp.where(
+        nonpreempt,
+        _ancestor_gate(q.parent, queue, num_levels, qan, q.quota, total_req),
+        True)
+    if reclaim:
+        # CanReclaimResources: stay within fair share along the chain
+        gate = _ancestor_gate(q.parent, queue, num_levels, qa,
+                              fair_share, total_req) & nonpreempt_quota_ok
+    else:
+        gate = nonpreempt_quota_ok
+
+    cand = victim_candidates(
+        state, gang_idx, reclaim=reclaim, already_victim=result.victim)
+    gate &= jnp.any(cand)
+
+    unit_rank, num_units = _rank_eviction_units(state, cand, qa, fair_share)
+    reclaimer_under_quota = _ancestor_gate(
+        q.parent, queue, num_levels, qa, q.quota, total_req)
+    quota_eff = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
+    m_req = jnp.where(cand[:, None], r.req, 0.0)               # [M, R]
+    leveled = jax.vmap(
+        lambda vq: _leveled_queue(chain, q.depth, vq, queue))(
+            jnp.maximum(r.queue, 0))                           # [M]
+
+    # idle_gpus-style prefilter: fast-forward to the first scenario whose
+    # aggregate free + freed covers the preemptor's total request.
+    unit_freed = jax.ops.segment_sum(
+        m_req, jnp.minimum(unit_rank, r.m), num_segments=r.m + 1)[:r.m]
+    cum_freed = jnp.cumsum(unit_freed, axis=0)                 # [M, R]
+    cluster_free = jnp.sum(
+        jnp.where(n.valid[:, None], free + n.releasing, 0.0), axis=0)
+    enough = jnp.all(cluster_free[None, :] + cum_freed + EPS
+                     >= total_req[None, :], axis=-1)           # [M]
+    gate_prefilter = jnp.any(enough)  # no scenario can ever fit => skip all
+
+    T = g.t
+    alloc_cfg = config.placement
+
+    def freed_tensors(mask):
+        """(freed_nodes [N, R], freed_queues [Q, R] rolled-up)."""
+        freed_nodes, freed_q, _ = freed_by_mask(state, mask, chain)
+        return freed_nodes, freed_q
+
+    def unit_strategy_ok(k, freed_q_excl):
+        """FitsReclaimStrategy for the unit being added at rank ``k``,
+        against remaining shares *before* this step."""
+        if not reclaim:
+            return jnp.asarray(True)
+        in_unit = cand & (unit_rank == k)
+        # leveled queue of this unit's pods (all share one gang => one queue)
+        lq = jnp.max(jnp.where(in_unit, leveled, -1))
+        lq_safe = jnp.maximum(lq, 0)
+        remaining = qa[lq_safe] - freed_q_excl[lq_safe]        # [R]
+        over_fs = jnp.any(remaining > fair_share[lq_safe] + EPS)
+        over_quota = jnp.any(remaining > quota_eff[lq_safe] + EPS)
+        return (lq < 0) | over_fs | (reclaimer_under_quota & over_quota)
+
+    def cond(carry):
+        k, done, prefix_ok, _ = carry
+        return (~done) & prefix_ok & (k < num_units)
+
+    def body(carry):
+        k, done, prefix_ok, best = carry
+        mask_excl = cand & (unit_rank < k)
+        _, freed_q_excl = freed_tensors(mask_excl)
+        prefix_ok = prefix_ok & unit_strategy_ok(k, freed_q_excl)
+
+        def run(_):
+            mask_k = cand & (unit_rank <= k)
+            freed_nodes, freed_queues = freed_tensors(mask_k)
+            free2, qa2, qan2, nodes_t, pipe_t, success = _attempt_gang(
+                state, gang_idx, free + freed_nodes, qa - freed_queues,
+                qan, num_levels, alloc_cfg)
+            return free2, qa2, qan2, nodes_t, pipe_t, success
+
+        def skip(_):
+            return (free, qa, qan, jnp.full((T,), -1, jnp.int32),
+                    jnp.zeros((T,), bool), jnp.asarray(False))
+
+        free2, qa2, qan2, nodes_t, pipe_t, success = lax.cond(
+            prefix_ok & enough[jnp.minimum(k, r.m - 1)], run, skip, None)
+        best = jax.tree.map(
+            lambda new, old: jnp.where(success, new, old),
+            (free2, qa2, qan2, nodes_t, pipe_t, k), best)
+        return k + 1, success, prefix_ok, best
+
+    empty = (free, qa, qan, jnp.full((T,), -1, jnp.int32),
+             jnp.zeros((T,), bool), jnp.asarray(0, jnp.int32))
+
+    def search(_):
+        _, done, _, best = lax.while_loop(
+            cond, body,
+            (jnp.asarray(0, jnp.int32), jnp.asarray(False),
+             jnp.asarray(True), empty))
+        return done, best
+
+    def no_search(_):
+        return jnp.asarray(False), empty
+
+    success, (free2, qa2, qan2, nodes_t, pipe_t, k_win) = lax.cond(
+        gate & gate_prefilter, search, no_search, None)
+
+    victim_mask = cand & (unit_rank <= k_win) & success
+    return success, victim_mask, nodes_t, pipe_t, free2, qa2, qan2
+
+
+def run_victim_action(
+    state: ClusterState,
+    fair_share: jax.Array,
+    result: AllocationResult,
+    *,
+    num_levels: int,
+    reclaim: bool,
+    config: VictimConfig = VictimConfig(),
+) -> AllocationResult:
+    """The reclaim / preempt action: scan pending unallocated gangs in
+    fairness order, solving victim scenarios for each.
+
+    Functional equivalent of ``reclaim.Execute`` / ``preempt.Execute``.
+    Successful preemptors are committed as *pipelined* placements (they
+    wait for their victims' pods to terminate — the reference pipelines
+    preemptors onto releasing resources the same way).
+    """
+    g, q = state.gangs, state.queues
+    G = g.g
+    total = state.total_capacity
+    chain = _chain_membership(q.parent, num_levels)
+    steps = G if config.queue_depth is None else min(G, config.queue_depth)
+
+    def step(carry, _):
+        res, remaining = carry
+        gi = ordering.select_next_gang(
+            g, q, res.queue_allocated, fair_share, total, remaining)
+        runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0) \
+            & ~res.allocated[gi]
+
+        def attempt(_):
+            return solve_for_preemptor(
+                state, gi, res, fair_share, chain,
+                num_levels=num_levels, reclaim=reclaim, config=config)
+
+        def skip(_):
+            T = g.t
+            return (jnp.asarray(False), jnp.zeros_like(res.victim),
+                    jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
+                    res.free, res.queue_allocated,
+                    res.queue_allocated_nonpreemptible)
+
+        success, victims, nodes_t, pipe_t, free2, qa2, qan2 = lax.cond(
+            runnable, attempt, skip, None)
+        res = res.replace(
+            free=jnp.where(success, free2, res.free),
+            queue_allocated=jnp.where(success, qa2, res.queue_allocated),
+            queue_allocated_nonpreemptible=jnp.where(
+                success, qan2, res.queue_allocated_nonpreemptible),
+            placements=res.placements.at[gi].set(
+                jnp.where(success, nodes_t, res.placements[gi])),
+            # preemptors pipeline onto their victims' releasing resources
+            pipelined=res.pipelined.at[gi].set(
+                jnp.where(success, nodes_t >= 0, res.pipelined[gi])),
+            allocated=res.allocated.at[gi].set(res.allocated[gi] | success),
+            attempted=res.attempted.at[gi].set(res.attempted[gi] | runnable),
+            victim=res.victim | victims,
+        )
+        remaining = remaining.at[gi].set(False)
+        return (res, remaining), None
+
+    remaining0 = g.valid & (g.backoff <= 0) & ~result.allocated
+    (res, _), _ = lax.scan(step, (result, remaining0), None, length=steps)
+    return res
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_levels", "reclaim", "config"))
+def run_victim_action_jit(state, fair_share, result, *, num_levels,
+                          reclaim, config=VictimConfig()):
+    return run_victim_action(state, fair_share, result,
+                             num_levels=num_levels, reclaim=reclaim,
+                             config=config)

@@ -75,6 +75,11 @@ class QueueState(struct.PyTreeNode):
     fair_share: jax.Array    # f32 [Q, R]  output of the DRF division kernel
     valid: jax.Array         # bool [Q]
     creation_order: jax.Array  # i32 [Q]  tie-break (older first)
+    #: minruntime protection (ref queue_types.go PreemptMinRuntime /
+    #: ReclaimMinRuntime, plugins/minruntime) — seconds a job in this queue
+    #: must have run before it may be victimized.
+    preempt_min_runtime: jax.Array  # f32 [Q]
+    reclaim_min_runtime: jax.Array  # f32 [Q]
 
     @property
     def q(self) -> int:
@@ -104,6 +109,18 @@ class GangState(struct.PyTreeNode):
     task_portion: jax.Array  # f32 [G, T]  fractional accel request (0 = whole)
     required_level: jax.Array   # i32 [G]  topology level index, -1 = none
     preferred_level: jax.Array  # i32 [G]  topology level index, -1 = none
+    #: count of this gang's bound/running (non-releasing) pods — feeds
+    #: stalegangeviction and elastic ordering
+    running_count: jax.Array    # i32 [G]
+    #: tasks still needed to reach minMember this cycle:
+    #: ``max(0, min_member - running_count)`` — the reference's
+    #: GetNumAliveTasks/minAvailable offset (elastic scale-up gangs and
+    #: gangs with a bound-but-pipelined remainder need fewer than
+    #: min_member new placements to be whole).
+    min_needed: jax.Array       # i32 [G]
+    #: seconds the gang has been below minMember after starting; -1 = not
+    #: stale (ref PodGroupInfo staleness + stalegangeviction action)
+    stale_s: jax.Array          # f32 [G]
 
     @property
     def g(self) -> int:
@@ -259,10 +276,14 @@ def build_snapshot(
     q_limit = np.full((Q, R), UNLIMITED, np.float32)
     q_valid = np.zeros((Q,), bool)
     q_creation = np.zeros((Q,), np.int32)
+    q_preempt_mrt = np.zeros((Q,), np.float32)
+    q_reclaim_mrt = np.zeros((Q,), np.float32)
     for i, q in enumerate(queues):
         q_valid[i] = True
         q_priority[i] = q.priority
         q_creation[i] = i
+        q_preempt_mrt[i] = q.preempt_min_runtime
+        q_reclaim_mrt[i] = q.reclaim_min_runtime
         if q.parent is not None:
             q_parent[i] = q_index[q.parent]
         for r in range(R):
@@ -313,6 +334,9 @@ def build_snapshot(
         task_portion=np.zeros((G, T), np.float32),
         required_level=np.full((G,), -1, np.int32),
         preferred_level=np.full((G,), -1, np.int32),
+        running_count=np.zeros((G,), np.int32),
+        min_needed=np.zeros((G,), np.int32),
+        stale_s=np.full((G,), -1.0, np.float32),
     )
     task_names: list[list[str | None]] = [[None] * T for _ in range(G)]
     for i, g in enumerate(pod_groups):
@@ -376,6 +400,12 @@ def build_snapshot(
         rk["valid"][j] = True
         rk["releasing"][j] = pod.status == apis.PodStatus.RELEASING
         running_names[j] = pod.name
+        if grp >= 0 and pod.status != apis.PodStatus.RELEASING:
+            gk["running_count"][grp] += 1
+    for i, grp_obj in enumerate(pod_groups):
+        if grp_obj.stale_since is not None:
+            gk["stale_s"][i] = max(0.0, now - grp_obj.stale_since)
+    gk["min_needed"] = np.maximum(gk["min_member"] - gk["running_count"], 0)
 
     # --- derived node free / releasing -----------------------------------
     node_used = np.zeros((N, R), np.float32)
@@ -436,6 +466,8 @@ def build_snapshot(
             fair_share=jnp.zeros((Q, R), dtype),
             valid=jnp.asarray(q_valid),
             creation_order=jnp.asarray(q_creation),
+            preempt_min_runtime=jnp.asarray(q_preempt_mrt, dtype),
+            reclaim_min_runtime=jnp.asarray(q_reclaim_mrt, dtype),
         ),
         gangs=GangState(**{k: jnp.asarray(v) for k, v in gk.items()}),
         running=RunningState(**{k: jnp.asarray(v) for k, v in rk.items()}),

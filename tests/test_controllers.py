@@ -1,0 +1,128 @@
+"""Controller + stalegangeviction tests — ref
+``pkg/podgroupcontroller``/``pkg/queuecontroller`` unit tests and
+``actions/stalegangeviction`` integration tests."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.controllers import PodGroupController, QueueController
+from kai_scheduler_tpu.framework import Scheduler, SchedulerConfig
+from kai_scheduler_tpu.framework.session import SessionConfig
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+Vec = apis.ResourceVec
+QR = apis.QueueResource
+
+
+def small_cluster(gang_pods=4, min_member=4):
+    nodes = [apis.Node("node-0", Vec(8.0, 64.0, 256.0))]
+    queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+    group = apis.PodGroup("g0", queue="q0", min_member=min_member)
+    pods = [apis.Pod(f"g0-p{i}", "g0", resources=Vec(1.0, 1.0, 4.0))
+            for i in range(gang_pods)]
+    cluster = Cluster.from_objects(nodes, queues, [group], pods)
+    return cluster
+
+
+class TestPodGroupController:
+    def test_phase_lifecycle(self):
+        cluster = small_cluster()
+        ctl = PodGroupController()
+        ctl.reconcile(cluster)
+        g = cluster.pod_groups["g0"]
+        assert g.phase == apis.PodGroupPhase.PENDING
+
+        for i in range(4):
+            cluster.pods[f"g0-p{i}"].status = apis.PodStatus.BOUND
+            cluster.pods[f"g0-p{i}"].node = "node-0"
+        ctl.reconcile(cluster)
+        assert g.phase == apis.PodGroupPhase.SCHEDULED
+        assert g.last_start_timestamp is not None
+
+        cluster.tick()
+        ctl.reconcile(cluster)
+        assert g.phase == apis.PodGroupPhase.RUNNING
+
+    def test_staleness_stamped_when_below_min_member(self):
+        cluster = small_cluster()
+        ctl = PodGroupController()
+        for i in range(4):
+            cluster.pods[f"g0-p{i}"].status = apis.PodStatus.RUNNING
+            cluster.pods[f"g0-p{i}"].node = "node-0"
+        ctl.reconcile(cluster)
+        # two pods die
+        cluster.now = 10.0
+        del cluster.pods["g0-p2"], cluster.pods["g0-p3"]
+        ctl.reconcile(cluster)
+        g = cluster.pod_groups["g0"]
+        assert g.phase == apis.PodGroupPhase.STALE
+        assert g.stale_since == 10.0
+        # recovery clears staleness
+        cluster.submit(g, [apis.Pod(f"g0-p{i}", "g0",
+                                    resources=Vec(1.0, 1.0, 4.0),
+                                    status=apis.PodStatus.RUNNING,
+                                    node="node-0") for i in (2, 3)])
+        ctl.reconcile(cluster)
+        assert g.stale_since is None
+
+
+class TestQueueController:
+    def test_status_rollup(self):
+        nodes = [apis.Node("node-0", Vec(8.0, 64.0, 256.0))]
+        queues = [apis.Queue("dept"), apis.Queue("q0", parent="dept"),
+                  apis.Queue("q1", parent="dept")]
+        g0 = apis.PodGroup("g0", queue="q0", min_member=1)
+        g1 = apis.PodGroup(
+            "g1", queue="q1", min_member=1,
+            preemptibility=apis.Preemptibility.NON_PREEMPTIBLE)
+        pods = [
+            apis.Pod("a", "g0", resources=Vec(2.0, 2.0, 8.0),
+                     status=apis.PodStatus.RUNNING, node="node-0"),
+            apis.Pod("b", "g0", resources=Vec(1.0, 1.0, 4.0)),  # pending
+            apis.Pod("c", "g1", resources=Vec(3.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-0"),
+        ]
+        cluster = Cluster.from_objects(nodes, queues, [g0, g1], pods)
+        status = QueueController().reconcile(cluster)
+        assert status["q0"].allocated.accel == 2.0
+        assert status["q0"].requested.accel == 3.0
+        assert status["q1"].allocated_non_preemptible.accel == 3.0
+        assert status["dept"].allocated.accel == 5.0
+        assert status["dept"].requested.accel == 6.0
+
+
+class TestStaleGangEviction:
+    def test_stale_gang_evicted_after_grace(self):
+        cluster = small_cluster()
+        ctl = PodGroupController()
+        for i in range(4):
+            cluster.pods[f"g0-p{i}"].status = apis.PodStatus.RUNNING
+            cluster.pods[f"g0-p{i}"].node = "node-0"
+        ctl.reconcile(cluster)
+        del cluster.pods["g0-p3"]          # gang drops below minMember=4
+        cluster.now = 5.0
+        ctl.reconcile(cluster)
+
+        sched = Scheduler(SchedulerConfig(
+            actions=("stalegangeviction",),
+            session=SessionConfig(num_levels=1, stale_grace_s=60.0)))
+        # within grace: no eviction
+        r1 = sched.run_once(cluster)
+        assert len(r1.evictions) == 0
+        # past grace: remaining 3 pods evicted
+        cluster.now = 70.0
+        r2 = sched.run_once(cluster)
+        assert {e.pod_name for e in r2.evictions} == {
+            "g0-p0", "g0-p1", "g0-p2"}
+
+    def test_healthy_gang_not_evicted(self):
+        cluster = small_cluster()
+        ctl = PodGroupController()
+        for i in range(4):
+            cluster.pods[f"g0-p{i}"].status = apis.PodStatus.RUNNING
+            cluster.pods[f"g0-p{i}"].node = "node-0"
+        ctl.reconcile(cluster)
+        cluster.now = 100.0
+        sched = Scheduler(SchedulerConfig(
+            actions=("stalegangeviction",),
+            session=SessionConfig(num_levels=1)))
+        assert len(sched.run_once(cluster).evictions) == 0
