@@ -1,0 +1,11 @@
+"""Admission — pod mutating/validating webhooks (intake layer 5).
+
+Reference: ``pkg/admission/webhook/v1alpha2/`` — the mutating webhook
+stamps the scheduler name and injects GPU-sharing env/annotations
+(``podhooks/pod_mutator.go:54-63``); validating webhooks reject
+malformed fraction requests (gpusharing webhook) and enforce runtime
+class rules (runtimeenforcement).
+"""
+from .webhooks import AdmissionError, PodMutator, PodValidator
+
+__all__ = ["AdmissionError", "PodMutator", "PodValidator"]

@@ -1,0 +1,91 @@
+"""Pod admission webhooks — mutation + validation.
+
+Reference mapping:
+
+- :class:`PodMutator` ≡ ``pod_mutator.go:54-63`` — gate on scheduler
+  name, default the queue label, translate fraction annotations into the
+  pod's resource request (the reference injects env vars the device
+  runtime reads; here the portion is a first-class field).
+- :class:`PodValidator` ≡ the gpusharing validating webhook — reject
+  fractions outside (0, 1], mixed whole+fraction requests, and
+  memory-based requests alongside portions.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..apis import types as apis
+
+SCHEDULER_NAME = "kai-scheduler-tpu"
+QUEUE_LABEL = "kai.scheduler/queue"
+PORTION_ANNOTATION = "kai.scheduler/accel-fraction"
+MEMORY_ANNOTATION = "kai.scheduler/accel-memory-gib"
+
+
+class AdmissionError(ValueError):
+    """A validating webhook rejection."""
+
+
+@dataclasses.dataclass
+class PodMutator:
+    """Mutating webhook: defaults + fraction translation."""
+
+    default_queue: str = "default"
+    scheduler_name: str = SCHEDULER_NAME
+
+    def mutate(self, pod: apis.Pod,
+               annotations: dict[str, str] | None = None,
+               labels: dict[str, str] | None = None) -> apis.Pod:
+        """Apply admission mutations in place (returns the pod).
+
+        ``annotations``/``labels`` are the pod's metadata as a workload
+        operator would set them (the reference reads them off the pod
+        object; our Pod keeps resources first-class).
+        """
+        annotations = annotations or {}
+        labels = labels or {}
+        if PORTION_ANNOTATION in annotations and pod.accel_portion == 0:
+            pod.accel_portion = float(annotations[PORTION_ANNOTATION])
+        if MEMORY_ANNOTATION in annotations and pod.accel_memory_gib == 0:
+            pod.accel_memory_gib = float(annotations[MEMORY_ANNOTATION])
+        if not pod.node_selector and "kai.scheduler/node-selector" in annotations:
+            for kv in annotations["kai.scheduler/node-selector"].split(","):
+                if "=" in kv:
+                    k, v = kv.split("=", 1)
+                    pod.node_selector[k.strip()] = v.strip()
+        return pod
+
+    def queue_for(self, labels: dict[str, str] | None) -> str:
+        return (labels or {}).get(QUEUE_LABEL, self.default_queue)
+
+
+@dataclasses.dataclass
+class PodValidator:
+    """Validating webhook: fraction sanity — ref gpusharing webhook."""
+
+    def validate(self, pod: apis.Pod) -> None:
+        frac = pod.accel_portion
+        mem = pod.accel_memory_gib
+        whole = pod.resources.accel
+        if frac < 0:
+            raise AdmissionError(
+                f"pod {pod.name}: accel fraction {frac} is negative")
+        if frac > 1:
+            raise AdmissionError(
+                f"pod {pod.name}: accel fraction {frac} exceeds one device"
+                " — request whole devices instead")
+        if mem < 0:
+            raise AdmissionError(
+                f"pod {pod.name}: accel memory {mem} GiB is negative")
+        if frac > 0 and mem > 0:
+            raise AdmissionError(
+                f"pod {pod.name}: fraction and memory-based accel requests"
+                " are mutually exclusive")
+        if (frac > 0 or mem > 0) and whole > 0:
+            raise AdmissionError(
+                f"pod {pod.name}: whole-device request ({whole}) cannot be"
+                " combined with a fractional/memory request")
+        if whole != int(whole):
+            raise AdmissionError(
+                f"pod {pod.name}: whole-device accel request must be an"
+                f" integer, got {whole} (use fractions for sharing)")

@@ -83,8 +83,14 @@ class Queue:
     parent: str | None = None
     priority: int = 0
     accel: QueueResource = dataclasses.field(default_factory=QueueResource)
-    cpu: QueueResource = dataclasses.field(default_factory=QueueResource)
-    memory: QueueResource = dataclasses.field(default_factory=QueueResource)
+    #: cpu/memory deserved quota defaults to UNLIMITED — accelerators are
+    #: the managed resource; an unspecified cpu/mem quota must not gate
+    #: non-preemptible workloads (matches the reference treating absent
+    #: queue resources as unbounded deserved share).
+    cpu: QueueResource = dataclasses.field(
+        default_factory=lambda: QueueResource(quota=UNLIMITED))
+    memory: QueueResource = dataclasses.field(
+        default_factory=lambda: QueueResource(quota=UNLIMITED))
     #: minimum runtime before a job in this queue may be preempted / reclaimed
     #: (seconds) — ref queue_types.go ``PreemptMinRuntime``/``ReclaimMinRuntime``.
     preempt_min_runtime: float = 0.0
@@ -127,6 +133,14 @@ class Pod:
     #: fraction of one accelerator requested (GPU-sharing); 0 => whole devices
     #: ref api/resource_info/gpu_resource_requirment.go portion
     accel_portion: float = 0.0
+    #: memory-based share request, GiB of one device's memory (converted to
+    #: a per-node portion against Node.accel_memory_gib) — ref
+    #: gpu_resource_requirment.go gpuMemory
+    accel_memory_gib: float = 0.0
+    #: concrete device indices occupied on the bound node — whole-device
+    #: pods list each device; fractional pods list their shared device.
+    #: Assigned by the binder (ref SelectedGPUGroups + reservation pod).
+    accel_devices: list[int] = dataclasses.field(default_factory=list)
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     creation_timestamp: float = 0.0
 
@@ -242,7 +256,9 @@ class BindRequest:
     received_resource_type: ReceivedResourceType = ReceivedResourceType.REGULAR
     received_accel_count: int = 0
     received_accel_portion: float = 0.0
-    selected_accel_groups: list[str] = dataclasses.field(default_factory=list)
+    #: device indices chosen by the scheduler (fractional: the shared
+    #: device; whole: filled by the binder) — ref SelectedGPUGroups
+    selected_accel_groups: list[int] = dataclasses.field(default_factory=list)
     backoff_limit: int = 3
     #: filled by the binder
     phase: str = "Pending"   # Pending | Succeeded | Failed

@@ -93,7 +93,8 @@ class Binder:
                 for plugin in self.plugins:
                     plugin.pre_bind(cluster, pod, br)
                     done.append(plugin)
-                cluster.bind_pod(br.pod_name, br.selected_node)
+                cluster.bind_pod(br.pod_name, br.selected_node,
+                                 devices=br.selected_accel_groups or None)
             except Exception:
                 for plugin in reversed(done):
                     plugin.rollback(cluster, pod, br)

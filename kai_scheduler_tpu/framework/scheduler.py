@@ -77,26 +77,32 @@ def _allocate_action() -> Action:
     return run
 
 
-@register_action("reclaim")
-def _reclaim_action() -> Action:
-    """Cross-queue fairness enforcement — ref ``actions/reclaim``."""
+def _victim_action(mode: str) -> Action:
     def run(session: Session, result: CycleResult) -> None:
         result.tensors = run_victim_action_jit(
             session.state, session.state.queues.fair_share, result.tensors,
-            num_levels=session.config.num_levels, reclaim=True,
+            num_levels=session.config.num_levels, mode=mode,
             config=session.config.victims)
     return run
+
+
+@register_action("reclaim")
+def _reclaim_action() -> Action:
+    """Cross-queue fairness enforcement — ref ``actions/reclaim``."""
+    return _victim_action("reclaim")
 
 
 @register_action("preempt")
 def _preempt_action() -> Action:
     """Intra-queue priority preemption — ref ``actions/preempt``."""
-    def run(session: Session, result: CycleResult) -> None:
-        result.tensors = run_victim_action_jit(
-            session.state, session.state.queues.fair_share, result.tensors,
-            num_levels=session.config.num_levels, reclaim=False,
-            config=session.config.victims)
-    return run
+    return _victim_action("preempt")
+
+
+@register_action("consolidation")
+def _consolidation_action() -> Action:
+    """Evict-and-reallocate defragmentation — ref ``actions/consolidation``
+    (every victim must be re-placed; see ``victim_move``)."""
+    return _victim_action("consolidate")
 
 
 @register_action("stalegangeviction")
@@ -116,12 +122,11 @@ class SchedulerConfig:
     """ref ``conf/scheduler_conf.go:49-62`` SchedulerConfiguration.
 
     Default action pipeline matches the reference default order
-    (``conf_util/scheduler_conf_util.go:37``) minus the actions not yet
-    implemented.
+    (``conf_util/scheduler_conf_util.go:37``).
     """
 
-    actions: tuple[str, ...] = ("allocate", "reclaim", "preempt",
-                                "stalegangeviction")
+    actions: tuple[str, ...] = ("allocate", "consolidation", "reclaim",
+                                "preempt", "stalegangeviction")
     session: SessionConfig = dataclasses.field(default_factory=SessionConfig)
     schedule_period_s: float = 1.0
 

@@ -83,9 +83,12 @@ class Session:
         (``stmt.Pipeline`` vs ``stmt.Allocate``).
         """
         placements = np.asarray(result.placements)
+        devices = np.asarray(result.placement_device)
         allocated = np.asarray(result.allocated)
         pipelined = np.asarray(result.pipelined)
         portions = np.asarray(self.state.gangs.task_portion)
+        mems = np.asarray(self.state.gangs.task_accel_mem)
+        reqs = np.asarray(self.state.gangs.task_req)
         out: list[apis.BindRequest] = []
         for gi, gang_name in enumerate(self.index.gang_names):
             if not allocated[gi]:
@@ -95,13 +98,18 @@ class Session:
                 if pod_name is None or node < 0 or pipelined[gi, ti]:
                     continue
                 portion = float(portions[gi, ti])
+                is_frac = portion > 0 or mems[gi, ti] > 0
+                dev = int(devices[gi, ti])
                 out.append(apis.BindRequest(
                     pod_name=pod_name,
                     selected_node=self.index.node_names[node],
                     received_resource_type=(
-                        apis.ReceivedResourceType.FRACTION if portion > 0
+                        apis.ReceivedResourceType.FRACTION if is_frac
                         else apis.ReceivedResourceType.REGULAR),
                     received_accel_portion=portion,
+                    received_accel_count=(
+                        0 if is_frac else int(round(float(reqs[gi, ti, 0])))),
+                    selected_accel_groups=[dev] if dev >= 0 else [],
                     backoff_limit=self.config.default_bind_backoff_limit,
                 ))
         return out

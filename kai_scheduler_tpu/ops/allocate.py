@@ -46,8 +46,9 @@ from jax import lax
 from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
-from .predicates import feasible_nodes
-from .scoring import PlacementConfig, score_nodes_for_task
+from .predicates import feasible_nodes, node_portion
+from .scoring import (W_TOPOLOGY, PlacementConfig, gpu_sharing_score,
+                      pick_device, score_nodes_for_task)
 
 EPS = 1e-6
 
@@ -62,15 +63,24 @@ class AllocationResult(struct.PyTreeNode):
     """
 
     placements: jax.Array     # i32 [G, T]  node index per task, -1 unplaced
+    #: shared-device index per fractional task (-1 = whole-device/none) —
+    #: feeds BindRequest.selected_accel_groups
+    placement_device: jax.Array  # i32 [G, T]
     pipelined: jax.Array      # bool [G, T] placed onto releasing resources
     allocated: jax.Array      # bool [G]    gang committed this cycle
     attempted: jax.Array      # bool [G]    gang was popped and tried
     free: jax.Array           # f32 [N, R]  idle+releasing pool after commits
+    device_free: jax.Array    # f32 [N, D]  per-device share pool
     queue_allocated: jax.Array  # f32 [Q, R]
     queue_allocated_nonpreemptible: jax.Array  # f32 [Q, R]
     #: running pods evicted this cycle (victims of reclaim/preempt/
     #: consolidation) — bool [M]
     victim: jax.Array
+    #: consolidation move target per running pod — i32 [M] node index the
+    #: evicted pod is planned to restart on (-1 = not a move); the
+    #: equivalent of the pipelined BindRequest the reference creates for
+    #: re-placed consolidation victims
+    victim_move: jax.Array
 
 
 def init_result(state: ClusterState) -> AllocationResult:
@@ -79,13 +89,16 @@ def init_result(state: ClusterState) -> AllocationResult:
     G, T = g.g, g.t
     return AllocationResult(
         placements=jnp.full((G, T), -1, jnp.int32),
+        placement_device=jnp.full((G, T), -1, jnp.int32),
         pipelined=jnp.zeros((G, T), bool),
         allocated=jnp.zeros((G,), bool),
         attempted=jnp.zeros((G,), bool),
         free=n.free,
+        device_free=n.device_free,
         queue_allocated=q.allocated,
         queue_allocated_nonpreemptible=q.allocated_nonpreemptible,
         victim=jnp.zeros((state.running.m,), bool),
+        victim_move=jnp.full((state.running.m,), -1, jnp.int32),
     )
 
 
@@ -134,23 +147,33 @@ class AllocateConfig:
     dynamic_order: bool = True
 
 
-def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
-                  free: jax.Array, q_alloc: jax.Array, q_alloc_np: jax.Array,
-                  num_levels: int, config: AllocateConfig):
-    """Try to place one gang; returns tentative post-gang state + success."""
+def _attempt_gang_in_domain(
+        state: ClusterState, gang_idx: jax.Array,
+        free: jax.Array, device_free: jax.Array,
+        q_alloc: jax.Array, q_alloc_np: jax.Array,
+        num_levels: int, config: AllocateConfig,
+        domain_mask: jax.Array,        # bool [N] — allowed nodes
+        pref_doms: jax.Array,          # i32 [N]  preferred-level domain ids
+        has_pref: jax.Array):          # bool []
+    """Place one gang greedily within ``domain_mask`` — the task loop of
+    ``allocateTask`` (``actions/common/allocate.go:229``) including the
+    fractional-device path (``gpu_sharing/gpu_sharing.go:20-105``)."""
     g = state.gangs
     n = state.nodes
     T = g.t
+    D = n.d
     task_req = g.task_req[gang_idx]          # [T, R]
     task_valid = g.task_valid[gang_idx]      # [T]
     task_sel = g.task_selector[gang_idx]     # [T, K]
     task_portion = g.task_portion[gang_idx]  # [T]
+    task_mem = g.task_accel_mem[gang_idx]    # [T]
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
 
     def task_body(t, carry):
-        free_l, qa, qan, nodes_t, pipe_t, count = carry
+        free_l, dev_l, qa, qan, nodes_t, dev_t, pipe_t, count, pref_dom = carry
         req = task_req[t]
+        is_frac = (task_portion[t] > 0) | (task_mem[t] > 0)
         # queue capacity gates up the hierarchy (capacity_policy.go:26-50)
         gate = _ancestor_gate(state.queues.parent, queue, num_levels,
                               qa, state.queues.limit, req)
@@ -162,36 +185,160 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         ok = task_valid[t] & gate
 
         fit_idle = feasible_nodes(
-            n, req, task_sel[t], task_portion[t], free=free_l)        # [N]
+            n, req, task_sel[t], task_portion[t], task_mem[t],
+            free=free_l, device_free=dev_l) & domain_mask
         fit_pipe = feasible_nodes(
-            n, req, task_sel[t], task_portion[t], free=free_l,
-            include_releasing=True)                                    # [N]
+            n, req, task_sel[t], task_portion[t], task_mem[t],
+            free=free_l, device_free=dev_l,
+            include_releasing=True) & domain_mask                      # [N]
+        # preferred-level locality band (topology plugin node scoring):
+        # stick with the domain of the gang's first-placed task.
+        topo_band = jnp.where(
+            has_pref & (pref_dom >= 0) & (pref_doms == pref_dom),
+            W_TOPOLOGY, 0.0)                                           # [N]
+        portion_n = node_portion(n, task_portion[t], task_mem[t])      # [N]
+        sharing_band = gpu_sharing_score(dev_l, portion_n, is_frac)    # [N]
         scores = score_nodes_for_task(
-            n, free_l, req, fit_idle, fit_pipe, config.placement)      # [N]
+            n, free_l, req, fit_idle, fit_pipe, config.placement,
+            extra=topo_band + sharing_band)                            # [N]
         node = jnp.argmax(scores)
         placed = ok & jnp.any(fit_pipe)
         is_pipe = placed & ~fit_idle[node]
 
+        # ---- device bookkeeping (GPU-group allocation) ------------------
+        dev_row = dev_l[node]                                          # [D]
+        dev_rel_row = n.device_releasing[node]
+        p = portion_n[node]
+        # fractional: GpuOrderFn pick among idle-fitting devices; a
+        # pipelined fraction may dip into releasing share (bounded
+        # negative, like the node-level free carry)
+        frac_row = jnp.where(is_pipe, dev_row + dev_rel_row, dev_row)
+        frac_dev = pick_device(frac_row, p, pack=config.placement.device_pack)
+        # whole: take ceil(req) devices, idle-free first then releasing
+        k = jnp.round(req[0]).astype(jnp.int32)
+        eligible = dev_row + dev_rel_row >= 1.0 - EPS
+        rank_key = jnp.where(eligible, -dev_row, jnp.inf)
+        rank = jnp.sum(
+            (rank_key[None, :] < rank_key[:, None])
+            | ((rank_key[None, :] == rank_key[:, None])
+               & (jnp.arange(D)[None, :] < jnp.arange(D)[:, None])),
+            axis=-1)                                                   # [D]
+        take_whole = eligible & (rank < k)
+        dev_delta = jnp.where(
+            is_frac,
+            p * (jnp.arange(D) == frac_dev),
+            take_whole.astype(dev_row.dtype))
+        dev_delta = jnp.where(placed, dev_delta, 0.0)
+        dev_l = dev_l.at[node].add(-dev_delta)
+
         delta = jnp.where(placed, req, 0.0)
-        free_l = free_l.at[node].add(-delta)
+        # node-level accel debit uses the node's actual share (memory-
+        # based portions differ per node); queue debits stay canonical
+        delta_node = delta.at[0].set(
+            jnp.where(placed, jnp.where(is_frac, p, req[0]), 0.0))
+        free_l = free_l.at[node].add(-delta_node)
         qa = _ancestor_scatter(state.queues.parent, queue, num_levels, qa, delta)
         qan = _ancestor_scatter(
             state.queues.parent, queue, num_levels, qan,
             jnp.where(nonpreempt, delta, 0.0))
         nodes_t = nodes_t.at[t].set(jnp.where(placed, node, -1))
+        dev_t = dev_t.at[t].set(
+            jnp.where(placed & is_frac, frac_dev, -1))
         pipe_t = pipe_t.at[t].set(is_pipe)
         count = count + placed.astype(jnp.int32)
-        return free_l, qa, qan, nodes_t, pipe_t, count
+        pref_dom = jnp.where(placed & (pref_dom < 0), pref_doms[node],
+                             pref_dom)
+        return free_l, dev_l, qa, qan, nodes_t, dev_t, pipe_t, count, pref_dom
 
-    init = (free, q_alloc, q_alloc_np,
-            jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
-            jnp.asarray(0, jnp.int32))
-    free2, qa2, qan2, nodes_t, pipe_t, count = lax.fori_loop(
+    init = (free, device_free, q_alloc, q_alloc_np,
+            jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
+            jnp.zeros((T,), bool),
+            jnp.asarray(0, jnp.int32), jnp.asarray(-1, jnp.int32))
+    free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, count, _ = lax.fori_loop(
         0, T, task_body, init)
     # min_needed (not min_member): pods already bound/running count toward
     # the gang's quorum — elastic scale-up and pipelined-remainder gangs.
     success = count >= g.min_needed[gang_idx]
-    return free2, qa2, qan2, nodes_t, pipe_t, success
+    return free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success
+
+
+def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
+                  free: jax.Array, device_free: jax.Array,
+                  q_alloc: jax.Array, q_alloc_np: jax.Array,
+                  num_levels: int, config: AllocateConfig):
+    """Try to place one gang; returns tentative post-gang state + success.
+
+    Topology handling (ref ``plugins/topology`` SubsetNodesFn +
+    ``topology/job_filtering.go:34``): a gang with a *required* level is
+    attempted domain-by-domain — candidate domains at that level are
+    ordered binpack-style (least aggregate free accel first, i.e. fullest
+    domain first, ``topology/node_scoring.go``) and each attempt restricts
+    feasibility to the domain's nodes; the first succeeding domain wins
+    (checkpoint/rollback between attempts is value selection).  A
+    *preferred* level adds a locality score band instead (best-effort).
+    """
+    g, n = state.gangs, state.nodes
+    T = g.t
+    L = n.topology.shape[1]
+    N = n.n
+
+    pl = g.preferred_level[gang_idx]
+    has_pref = pl >= 0
+    pref_doms = n.topology[:, jnp.maximum(pl, 0)]              # [N]
+
+    rl = g.required_level[gang_idx]
+    has_req = rl >= 0
+
+    def unconstrained(_):
+        return _attempt_gang_in_domain(
+            state, gang_idx, free, device_free, q_alloc, q_alloc_np,
+            num_levels, config, n.valid, pref_doms, has_pref)
+
+    def constrained(_):
+        doms = n.topology[:, jnp.maximum(rl, 0)]               # [N]
+        # domain ids are globally dense over (level, path) — bound N*L
+        D = N * L
+        dom_seg = jnp.where(n.valid & (doms >= 0), doms, D)
+        avail = free + n.releasing
+        agg = jax.ops.segment_sum(
+            jnp.where(n.valid[:, None], avail, 0.0), dom_seg,
+            num_segments=D + 1)[:D]                            # [D, R]
+        has_node = jax.ops.segment_sum(
+            (n.valid & (doms >= 0)).astype(jnp.int32), dom_seg,
+            num_segments=D + 1)[:D] > 0
+        task_req = jnp.where(g.task_valid[gang_idx][:, None],
+                             g.task_req[gang_idx], 0.0)
+        total_req = task_req.sum(0)
+        fits = jnp.all(agg + EPS >= total_req[None, :], axis=-1) & has_node
+        # binpack the domain: fullest (least free accel) candidate first
+        dom_key = agg[:, 0]
+
+        empty = (free, device_free, q_alloc, q_alloc_np,
+                 jnp.full((T,), -1, jnp.int32),
+                 jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
+                 jnp.asarray(False))
+
+        def cond(carry):
+            tried, done, _ = carry
+            return ~done & jnp.any(fits & ~tried)
+
+        def body(carry):
+            tried, _, best = carry
+            cand = fits & ~tried
+            d = jnp.argmin(jnp.where(cand, dom_key, jnp.inf))
+            out = _attempt_gang_in_domain(
+                state, gang_idx, free, device_free, q_alloc, q_alloc_np,
+                num_levels, config, doms == d, pref_doms, has_pref)
+            success = out[-1]
+            best = jax.tree.map(
+                lambda nw, old: jnp.where(success, nw, old), out, best)
+            return tried.at[d].set(True), success, best
+
+        _, done, best = lax.while_loop(
+            cond, body, (jnp.zeros((D,), bool), jnp.asarray(False), empty))
+        return best
+
+    return lax.cond(has_req, constrained, unconstrained, None)
 
 
 def allocate(
@@ -226,8 +373,8 @@ def allocate(
 
     def step(carry, step_idx):
         res, remaining = carry
-        free, qa, qan = (res.free, res.queue_allocated,
-                         res.queue_allocated_nonpreemptible)
+        free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
+                              res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
             gi = ordering.select_next_gang(g, q, qa, fair_share, total, remaining)
         else:
@@ -235,28 +382,34 @@ def allocate(
         runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0)
 
         def attempt(args):
-            free, qa, qan = args
-            free2, qa2, qan2, nodes_t, pipe_t, success = _attempt_gang(
-                state, gi, free, qa, qan, num_levels, config)
+            free, dev, qa, qan = args
+            free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
+                _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
+                              config)
             # checkpoint/rollback: keep post-gang state only on success
             sel = lambda a, b: jnp.where(success, a, b)
-            return (sel(free2, free), sel(qa2, qa), sel(qan2, qan),
+            return (sel(free2, free), sel(dev2, dev), sel(qa2, qa),
+                    sel(qan2, qan),
                     jnp.where(success, nodes_t, -jnp.ones_like(nodes_t)),
+                    jnp.where(success, dev_t, -jnp.ones_like(dev_t)),
                     jnp.where(success, pipe_t, jnp.zeros_like(pipe_t)),
                     success)
 
         def skip(args):
-            free, qa, qan = args
-            return (free, qa, qan, jnp.full((T,), -1, jnp.int32),
+            free, dev, qa, qan = args
+            return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
+                    jnp.full((T,), -1, jnp.int32),
                     jnp.zeros((T,), bool), jnp.asarray(False))
 
-        free, qa, qan, nodes_t, pipe_t, success = lax.cond(
-            runnable, attempt, skip, (free, qa, qan))
+        free, dev, qa, qan, nodes_t, dev_t, pipe_t, success = lax.cond(
+            runnable, attempt, skip, (free, dev, qa, qan))
         res = res.replace(
-            free=free, queue_allocated=qa,
+            free=free, device_free=dev, queue_allocated=qa,
             queue_allocated_nonpreemptible=qan,
             placements=res.placements.at[gi].set(
                 jnp.where(runnable, nodes_t, res.placements[gi])),
+            placement_device=res.placement_device.at[gi].set(
+                jnp.where(runnable, dev_t, res.placement_device[gi])),
             pipelined=res.pipelined.at[gi].set(
                 jnp.where(runnable, pipe_t, res.pipelined[gi])),
             allocated=res.allocated.at[gi].set(res.allocated[gi] | success),

@@ -45,20 +45,68 @@ def selector_mask(node_labels: jax.Array, task_selector: jax.Array) -> jax.Array
 def resource_fit_mask(
     available: jax.Array,      # f32 [N, R]
     task_req: jax.Array,       # f32 [..., R]
-    task_portion: jax.Array | None = None,  # f32 [...]
 ) -> jax.Array:
     """True where the task's request fits the node's available vector.
 
-    A fractional task (portion > 0) requests ``portion`` of one device in
-    the accel slot instead of its whole-device count (the reference keeps
-    these in separate fields of GpuResourceRequirement; here the portion
-    overrides the accel component of the request when set).
+    The accel component of ``task_req`` already carries fractional /
+    memory-based shares (set at snapshot build), so this is a pure
+    broadcast compare; device-granular accel checks are layered on by
+    :func:`accel_fit_mask`.
     """
     req = jnp.asarray(task_req)
-    if task_portion is not None:
-        accel = jnp.where(task_portion > 0, task_portion, req[..., RESOURCE_ACCEL])
-        req = req.at[..., RESOURCE_ACCEL].set(accel)
     return jnp.all(available + EPS >= req[..., None, :], axis=-1)
+
+
+def node_portion(
+    nodes: NodeState,
+    task_portion: jax.Array,    # f32 [...]
+    task_accel_mem: jax.Array | None,  # f32 [...]
+) -> jax.Array:
+    """Per-node effective share of one device — f32 [..., N].
+
+    Plain fractions are node-independent; memory-based requests divide by
+    each node's per-device memory (ref memory-based GPU sharing,
+    ``gpu_resource_requirment.go`` gpuMemory / MemoryOfEveryGpuOnNode).
+    """
+    p = jnp.asarray(task_portion)[..., None] * jnp.ones_like(
+        nodes.device_memory_gib)
+    if task_accel_mem is not None:
+        mem = jnp.asarray(task_accel_mem)[..., None]
+        # NO clamp to 1.0: a request larger than a node's device memory
+        # yields portion > 1 and is correctly infeasible on that node
+        by_mem = mem / jnp.maximum(nodes.device_memory_gib, EPS)
+        p = jnp.where(mem > 0, by_mem, p)
+    return p
+
+
+def accel_fit_mask(
+    nodes: NodeState,
+    task_req: jax.Array,        # f32 [..., R]
+    task_portion: jax.Array | None,
+    task_accel_mem: jax.Array | None,
+    device_free: jax.Array,     # f32 [N, D]
+    include_releasing: bool,
+) -> jax.Array:
+    """Device-granular accel feasibility — the ``FittingGPUs`` check
+    (``gpu_sharing/gpu_sharing.go``): a fractional task needs ONE device
+    with enough free share; a whole-device task needs enough fully-free
+    devices.  bool [..., N]."""
+    df = device_free
+    if include_releasing:
+        df = df + nodes.device_releasing
+    req_accel = jnp.asarray(task_req)[..., RESOURCE_ACCEL]
+    if task_portion is None:
+        is_frac = jnp.zeros(jnp.shape(req_accel), bool)
+        p = jnp.zeros(jnp.shape(req_accel) + (nodes.n,))
+    else:
+        mem = (jnp.zeros_like(task_portion) if task_accel_mem is None
+               else jnp.asarray(task_accel_mem))
+        is_frac = (jnp.asarray(task_portion) > 0) | (mem > 0)
+        p = node_portion(nodes, task_portion, task_accel_mem)  # [..., N]
+    frac_ok = jnp.max(df, axis=-1) >= p - EPS                  # [..., N]
+    whole_free = jnp.sum((df >= 1.0 - EPS).astype(jnp.float32), axis=-1)
+    whole_ok = whole_free + EPS >= req_accel[..., None]
+    return jnp.where(is_frac[..., None], frac_ok, whole_ok)
 
 
 def feasible_nodes(
@@ -66,24 +114,39 @@ def feasible_nodes(
     task_req: jax.Array,        # f32 [..., R]
     task_selector: jax.Array,   # i32 [..., K]
     task_portion: jax.Array | None = None,
+    task_accel_mem: jax.Array | None = None,
     *,
     free: jax.Array | None = None,
+    device_free: jax.Array | None = None,
     include_releasing: bool = False,
 ) -> jax.Array:
     """Full predicate chain → bool [..., N].
 
-    ``free`` overrides the snapshot's idle vector (the allocation kernel
-    passes its *running* free tensor as allocation proceeds).
-    ``include_releasing`` gives the pipeline variant: a node qualifies if
-    the task fits once terminating pods release their resources
-    (ref ``pod_info.IsTaskAllocatableOnReleasingOrIdle``).
+    ``free`` / ``device_free`` override the snapshot's idle tensors (the
+    allocation kernel passes its *running* tensors as allocation
+    proceeds).  ``include_releasing`` gives the pipeline variant: a node
+    qualifies if the task fits once terminating pods release their
+    resources (ref ``pod_info.IsTaskAllocatableOnReleasingOrIdle``).
     """
     avail = nodes.free if free is None else free
+    df = nodes.device_free if device_free is None else device_free
     if include_releasing:
         avail = avail + nodes.releasing
-    fit = resource_fit_mask(avail, task_req, task_portion)
+    req = jnp.asarray(task_req)
+    if task_portion is not None:
+        # fractional / memory-based accel is checked at device granularity
+        # (the canonical accel quantity is a cluster-wide accounting value
+        # whose per-node share differs) — drop it from the node-sum check
+        mem = (jnp.zeros_like(task_portion) if task_accel_mem is None
+               else jnp.asarray(task_accel_mem))
+        is_frac = (jnp.asarray(task_portion) > 0) | (mem > 0)
+        req = req.at[..., RESOURCE_ACCEL].set(
+            jnp.where(is_frac, 0.0, req[..., RESOURCE_ACCEL]))
+    fit = resource_fit_mask(avail, req)
+    accel = accel_fit_mask(nodes, task_req, task_portion, task_accel_mem,
+                           df, include_releasing)
     sel = selector_mask(nodes.labels, task_selector)
-    return fit & sel & nodes.valid
+    return fit & accel & sel & nodes.valid
 
 
 def gang_feasibility(

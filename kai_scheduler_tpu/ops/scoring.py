@@ -42,6 +42,40 @@ class PlacementConfig:
 
     binpack_accel: bool = True
     binpack_cpu: bool = True
+    #: gpupack vs gpuspread at the device granularity: pack puts fractions
+    #: on the most-used fitting device, spread on the least-used
+    device_pack: bool = True
+
+
+def pick_device(device_row: jax.Array,       # f32 [D] free share per device
+                portion: jax.Array,          # f32 []
+                *, pack: bool) -> jax.Array:
+    """Choose the device for a fractional task on one node — the
+    GpuOrderFn (``plugins/gpupack/gpupack.go`` / ``gpuspread``): pack
+    prefers the most-used device that still fits, spread the least-used.
+    Returns i32 device index (undefined when nothing fits — callers mask).
+    """
+    fits = device_row >= portion - 1e-6
+    if pack:
+        key = jnp.where(fits, device_row, jnp.inf)
+        return jnp.argmin(key)
+    key = jnp.where(fits, device_row, -jnp.inf)
+    return jnp.argmax(key)
+
+
+def gpu_sharing_score(
+    device_free: jax.Array,    # f32 [N, D]
+    portion_n: jax.Array,      # f32 [..., N]  per-node effective portion
+    is_frac: jax.Array,        # bool [...]
+) -> jax.Array:
+    """gpusharingorder plugin: +W_GPU_SHARING on nodes where the fraction
+    can join an already-shared (partially used) device, keeping whole
+    devices free for whole-device tasks."""
+    partially_used = (device_free > 1e-6) & (device_free < 1.0 - 1e-6)
+    shared_fit = jnp.any(
+        partially_used & (device_free >= portion_n[..., None] - 1e-6),
+        axis=-1)
+    return jnp.where(is_frac[..., None] & shared_fit, W_GPU_SHARING, 0.0)
 
 
 def density_score(

@@ -78,21 +78,38 @@ class VictimConfig:
     saturation_multiplier: float = 1.0
     #: max preemptor gangs attempted per cycle (QueueDepthPerAction)
     queue_depth: int | None = None
+    #: cap on eviction units per consolidation scenario — ref
+    #: ``MaxNumberConsolidationPreemptees`` (consolidation.go)
+    max_consolidation_preemptees: int = 64
 
 
 def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
     """Resources released by evicting the masked running pods.
 
-    Returns (freed_nodes [N, R], freed_queues [Q, R],
-    freed_queues_nonpreemptible [Q, R]) with the queue tensors rolled up
-    the hierarchy via ``chain`` — shared by the victim solver and the
-    stalegangeviction action.
+    Returns (freed_nodes [N, R], freed_devices [N, D], freed_queues
+    [Q, R], freed_queues_nonpreemptible [Q, R]) with the queue tensors
+    rolled up the hierarchy via ``chain`` — shared by the victim solver
+    and the stalegangeviction action.
     """
     r = state.running
     n, q = state.nodes, state.queues
+    D = n.d
     req_m = jnp.where(mask[:, None], r.req, 0.0)
     freed_nodes = jax.ops.segment_sum(
         req_m, jnp.where(mask, jnp.maximum(r.node, 0), n.n),
+        num_segments=n.n + 1)[:n.n]
+    # device table: fractional pods return their held share to their
+    # device; whole-device pods return 1.0 per devices_mask bit
+    frac = mask & (r.device >= 0)
+    flat = jnp.maximum(r.node, 0) * D + jnp.maximum(r.device, 0)
+    freed_dev = jax.ops.segment_sum(
+        jnp.where(frac, r.accel_held, 0.0),
+        jnp.where(frac, flat, n.n * D),
+        num_segments=n.n * D + 1)[:n.n * D].reshape(n.n, D)
+    bits = ((r.devices_mask[:, None] >> jnp.arange(D)[None, :]) & 1)
+    whole_bits = bits.astype(req_m.dtype) * (mask & (r.device < 0))[:, None]
+    freed_dev = freed_dev + jax.ops.segment_sum(
+        whole_bits, jnp.where(mask, jnp.maximum(r.node, 0), n.n),
         num_segments=n.n + 1)[:n.n]
     leaf = jax.ops.segment_sum(
         req_m, jnp.where(mask, jnp.maximum(r.queue, 0), q.q),
@@ -104,7 +121,7 @@ def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
     chain_f = chain.astype(leaf.dtype)
     freed_q = jnp.einsum("qa,qr->ar", chain_f, leaf)
     freed_q_np = jnp.einsum("qa,qr->ar", chain_f, leaf_np)
-    return freed_nodes, freed_q, freed_q_np
+    return freed_nodes, freed_dev, freed_q, freed_q_np
 
 
 def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
@@ -128,7 +145,7 @@ def victim_candidates(
     state: ClusterState,
     gang_idx: jax.Array,
     *,
-    reclaim: bool,
+    mode: str,
     already_victim: jax.Array,   # bool [M]
 ) -> jax.Array:
     """bool [M] — pods eligible as victims for this preemptor.
@@ -139,6 +156,9 @@ def victim_candidates(
     Preempt filter (``buildFilterFuncForPreempt``): preemptible running
     pods of the *same* queue whose gang priority is strictly lower, past
     ``preemptMinRuntime``.
+    Consolidation (``actions/consolidation``): any preemptible running pod
+    of another gang — victims are *moved*, not lost, so no queue or
+    priority constraint applies (minruntime still protects).
     """
     r = state.running
     g = state.gangs
@@ -146,9 +166,12 @@ def victim_candidates(
     base = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
             & (r.gang >= 0) & ~already_victim)
     my_queue = g.queue[gang_idx]
-    if reclaim:
+    if mode == "reclaim":
         mrt = q.reclaim_min_runtime[jnp.maximum(r.queue, 0)]
         return base & (r.queue != my_queue) & (r.runtime_s >= mrt)
+    if mode == "consolidate":
+        mrt = q.preempt_min_runtime[jnp.maximum(r.queue, 0)]
+        return base & (r.gang != gang_idx) & (r.runtime_s >= mrt)
     mrt = q.preempt_min_runtime[jnp.maximum(r.queue, 0)]
     return (base & (r.queue == my_queue)
             & (r.priority < g.priority[gang_idx])
@@ -247,16 +270,19 @@ def solve_for_preemptor(
     chain: jax.Array,            # bool [Q, Q]
     *,
     num_levels: int,
-    reclaim: bool,
+    mode: str,                   # "reclaim" | "preempt" | "consolidate"
     config: VictimConfig,
 ):
     """One preemptor's scenario search — returns updated commit-set fields.
 
     (success, victim_mask [M], task placements [T], pipelined [T],
-    free', qa', qan')
+    moves [M], free', qa', qan')
     """
+    reclaim = mode == "reclaim"
+    consolidate = mode == "consolidate"
     g, q, n, r = state.gangs, state.queues, state.nodes, state.running
     free = result.free
+    dev = result.device_free
     qa = result.queue_allocated
     qan = result.queue_allocated_nonpreemptible
     queue = g.queue[gang_idx]
@@ -274,14 +300,21 @@ def solve_for_preemptor(
         # CanReclaimResources: stay within fair share along the chain
         gate = _ancestor_gate(q.parent, queue, num_levels, qa,
                               fair_share, total_req) & nonpreempt_quota_ok
+    elif consolidate:
+        # consolidation only serves pending *preemptible* jobs
+        # (``consolidation.go`` pending-preemptible filter)
+        gate = ~nonpreempt
     else:
         gate = nonpreempt_quota_ok
 
     cand = victim_candidates(
-        state, gang_idx, reclaim=reclaim, already_victim=result.victim)
+        state, gang_idx, mode=mode, already_victim=result.victim)
     gate &= jnp.any(cand)
 
     unit_rank, num_units = _rank_eviction_units(state, cand, qa, fair_share)
+    if consolidate:
+        num_units = jnp.minimum(num_units,
+                                config.max_consolidation_preemptees)
     reclaimer_under_quota = _ancestor_gate(
         q.parent, queue, num_levels, qa, q.quota, total_req)
     quota_eff = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
@@ -305,9 +338,9 @@ def solve_for_preemptor(
     alloc_cfg = config.placement
 
     def freed_tensors(mask):
-        """(freed_nodes [N, R], freed_queues [Q, R] rolled-up)."""
-        freed_nodes, freed_q, _ = freed_by_mask(state, mask, chain)
-        return freed_nodes, freed_q
+        """(freed_nodes [N, R], freed_devices [N, D], freed_queues [Q, R])."""
+        freed_nodes, freed_dev, freed_q, _ = freed_by_mask(state, mask, chain)
+        return freed_nodes, freed_dev, freed_q
 
     def unit_strategy_ok(k, freed_q_excl):
         """FitsReclaimStrategy for the unit being added at rank ``k``,
@@ -323,37 +356,54 @@ def solve_for_preemptor(
         over_quota = jnp.any(remaining > quota_eff[lq_safe] + EPS)
         return (lq < 0) | over_fs | (reclaimer_under_quota & over_quota)
 
+    no_moves = jnp.full((r.m,), -1, jnp.int32)
+
     def cond(carry):
         k, done, prefix_ok, _ = carry
         return (~done) & prefix_ok & (k < num_units)
 
     def body(carry):
         k, done, prefix_ok, best = carry
-        mask_excl = cand & (unit_rank < k)
-        _, freed_q_excl = freed_tensors(mask_excl)
-        prefix_ok = prefix_ok & unit_strategy_ok(k, freed_q_excl)
+        if reclaim:
+            mask_excl = cand & (unit_rank < k)
+            _, _, freed_q_excl = freed_tensors(mask_excl)
+            prefix_ok = prefix_ok & unit_strategy_ok(k, freed_q_excl)
 
         def run(_):
             mask_k = cand & (unit_rank <= k)
-            freed_nodes, freed_queues = freed_tensors(mask_k)
-            free2, qa2, qan2, nodes_t, pipe_t, success = _attempt_gang(
-                state, gang_idx, free + freed_nodes, qa - freed_queues,
-                qan, num_levels, alloc_cfg)
-            return free2, qa2, qan2, nodes_t, pipe_t, success
+            freed_nodes, freed_dev, freed_queues = freed_tensors(mask_k)
+            # consolidation victims are moved, not removed — their queue
+            # allocation stays (allPodsReallocated validator below)
+            qa_eff = qa if consolidate else qa - freed_queues
+            free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
+                _attempt_gang(state, gang_idx, free + freed_nodes,
+                              dev + freed_dev, qa_eff, qan, num_levels,
+                              alloc_cfg)
+            if consolidate:
+                free3, dev3, moves, all_ok = _replace_victims(
+                    state, mask_k, free2, dev2)
+                return (free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t,
+                        moves, success & all_ok)
+            return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t,
+                    no_moves, success)
 
         def skip(_):
-            return (free, qa, qan, jnp.full((T,), -1, jnp.int32),
-                    jnp.zeros((T,), bool), jnp.asarray(False))
+            return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
+                    jnp.full((T,), -1, jnp.int32),
+                    jnp.zeros((T,), bool), no_moves, jnp.asarray(False))
 
-        free2, qa2, qan2, nodes_t, pipe_t, success = lax.cond(
-            prefix_ok & enough[jnp.minimum(k, r.m - 1)], run, skip, None)
+        free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, success = \
+            lax.cond(prefix_ok & enough[jnp.minimum(k, r.m - 1)],
+                     run, skip, None)
         best = jax.tree.map(
             lambda new, old: jnp.where(success, new, old),
-            (free2, qa2, qan2, nodes_t, pipe_t, k), best)
+            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, k),
+            best)
         return k + 1, success, prefix_ok, best
 
-    empty = (free, qa, qan, jnp.full((T,), -1, jnp.int32),
-             jnp.zeros((T,), bool), jnp.asarray(0, jnp.int32))
+    empty = (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
+             jnp.full((T,), -1, jnp.int32),
+             jnp.zeros((T,), bool), no_moves, jnp.asarray(0, jnp.int32))
 
     def search(_):
         _, done, _, best = lax.while_loop(
@@ -365,11 +415,74 @@ def solve_for_preemptor(
     def no_search(_):
         return jnp.asarray(False), empty
 
-    success, (free2, qa2, qan2, nodes_t, pipe_t, k_win) = lax.cond(
-        gate & gate_prefilter, search, no_search, None)
+    success, (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
+              k_win) = lax.cond(gate & gate_prefilter, search,
+                                no_search, None)
 
     victim_mask = cand & (unit_rank <= k_win) & success
-    return success, victim_mask, nodes_t, pipe_t, free2, qa2, qan2
+    return (success, victim_mask, nodes_t, dev_t, pipe_t, moves,
+            free2, dev2, qa2, qan2)
+
+
+def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
+                     device_free: jax.Array):
+    """Greedy re-placement of evicted consolidation victims — the
+    ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
+    scenario is valid only if *every* victim fits somewhere on the
+    post-preemptor state.  Resource-only feasibility (running pods carry
+    no selector in the snapshot); binpack by least free accel.
+
+    Returns (free' [N, R], device_free' [N, D], moves [M] i32 node per
+    victim, all_ok [])."""
+    r, n = state.running, state.nodes
+    M = r.m
+    D = n.d
+
+    def body(m, carry):
+        free_l, dev_l, moves, all_ok = carry
+        needed = mask[m]
+        req = r.req[m]
+        is_frac = r.device[m] >= 0
+        # memory-based portions are node-relative: recompute for every
+        # candidate target (a 40GiB share is 0.5 of an 80GiB device but
+        # 2.5 of a 16GiB one)
+        p_n = jnp.where(
+            r.accel_mem[m] > 0,
+            r.accel_mem[m] / jnp.maximum(n.device_memory_gib, EPS),
+            r.accel_held[m])                                   # [N]
+        fit = jnp.all(free_l + EPS >= req[None, :], axis=-1) & n.valid
+        frac_fit = jnp.max(dev_l, axis=-1) >= p_n - EPS
+        whole_free = jnp.sum((dev_l >= 1.0 - EPS).astype(free_l.dtype),
+                             axis=-1)
+        whole_fit = whole_free + EPS >= req[0]
+        fit = fit & jnp.where(is_frac, frac_fit, whole_fit)
+        score = jnp.where(fit, -free_l[:, 0], -jnp.inf)
+        node = jnp.argmax(score)
+        placed = needed & jnp.any(fit)
+        p = p_n[node]
+        delta = jnp.where(placed, req, 0.0)
+        delta = delta.at[0].set(
+            jnp.where(placed, jnp.where(is_frac, p, req[0]), 0.0))
+        free_l = free_l.at[node].add(-delta)
+        # device debit: fraction joins its best-fitting device; whole
+        # takes the first fully-free devices
+        dev_row = dev_l[node]
+        frac_dev = jnp.argmax(dev_row)
+        k = jnp.round(req[0]).astype(jnp.int32)
+        fully = dev_row >= 1.0 - EPS
+        take = fully & (jnp.cumsum(fully.astype(jnp.int32)) <= k)
+        dev_delta = jnp.where(
+            is_frac, p * (jnp.arange(D) == frac_dev),
+            take.astype(dev_row.dtype))
+        dev_l = dev_l.at[node].add(-jnp.where(placed, dev_delta, 0.0))
+        moves = moves.at[m].set(jnp.where(placed, node, -1))
+        all_ok = all_ok & (~needed | placed)
+        return free_l, dev_l, moves, all_ok
+
+    return lax.fori_loop(
+        0, M, body,
+        (free, device_free, jnp.full((M,), -1, jnp.int32),
+         jnp.asarray(True)))
 
 
 def run_victim_action(
@@ -378,17 +491,20 @@ def run_victim_action(
     result: AllocationResult,
     *,
     num_levels: int,
-    reclaim: bool,
+    mode: str,                   # "reclaim" | "preempt" | "consolidate"
     config: VictimConfig = VictimConfig(),
 ) -> AllocationResult:
-    """The reclaim / preempt action: scan pending unallocated gangs in
-    fairness order, solving victim scenarios for each.
+    """The reclaim / preempt / consolidation action: scan pending
+    unallocated gangs in fairness order, solving victim scenarios for each.
 
-    Functional equivalent of ``reclaim.Execute`` / ``preempt.Execute``.
-    Successful preemptors are committed as *pipelined* placements (they
-    wait for their victims' pods to terminate — the reference pipelines
-    preemptors onto releasing resources the same way).
+    Functional equivalent of ``reclaim.Execute`` / ``preempt.Execute`` /
+    ``consolidation.Execute``.  Successful preemptors are committed as
+    *pipelined* placements (they wait for their victims' pods to
+    terminate — the reference pipelines preemptors onto releasing
+    resources the same way); consolidation victims additionally get a
+    planned re-placement node in ``victim_move``.
     """
+    assert mode in ("reclaim", "preempt", "consolidate"), mode
     g, q = state.gangs, state.queues
     G = g.g
     total = state.total_capacity
@@ -405,30 +521,37 @@ def run_victim_action(
         def attempt(_):
             return solve_for_preemptor(
                 state, gi, res, fair_share, chain,
-                num_levels=num_levels, reclaim=reclaim, config=config)
+                num_levels=num_levels, mode=mode, config=config)
 
         def skip(_):
             T = g.t
             return (jnp.asarray(False), jnp.zeros_like(res.victim),
+                    jnp.full((T,), -1, jnp.int32),
                     jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
-                    res.free, res.queue_allocated,
+                    jnp.full((state.running.m,), -1, jnp.int32),
+                    res.free, res.device_free, res.queue_allocated,
                     res.queue_allocated_nonpreemptible)
 
-        success, victims, nodes_t, pipe_t, free2, qa2, qan2 = lax.cond(
-            runnable, attempt, skip, None)
+        (success, victims, nodes_t, dev_t, pipe_t, moves,
+         free2, dev2, qa2, qan2) = lax.cond(runnable, attempt, skip, None)
         res = res.replace(
             free=jnp.where(success, free2, res.free),
+            device_free=jnp.where(success, dev2, res.device_free),
             queue_allocated=jnp.where(success, qa2, res.queue_allocated),
             queue_allocated_nonpreemptible=jnp.where(
                 success, qan2, res.queue_allocated_nonpreemptible),
             placements=res.placements.at[gi].set(
                 jnp.where(success, nodes_t, res.placements[gi])),
+            placement_device=res.placement_device.at[gi].set(
+                jnp.where(success, dev_t, res.placement_device[gi])),
             # preemptors pipeline onto their victims' releasing resources
             pipelined=res.pipelined.at[gi].set(
                 jnp.where(success, nodes_t >= 0, res.pipelined[gi])),
             allocated=res.allocated.at[gi].set(res.allocated[gi] | success),
             attempted=res.attempted.at[gi].set(res.attempted[gi] | runnable),
             victim=res.victim | victims,
+            victim_move=jnp.where(success & (moves >= 0), moves,
+                                  res.victim_move),
         )
         remaining = remaining.at[gi].set(False)
         return (res, remaining), None
@@ -439,9 +562,9 @@ def run_victim_action(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_levels", "reclaim", "config"))
+                   static_argnames=("num_levels", "mode", "config"))
 def run_victim_action_jit(state, fair_share, result, *, num_levels,
-                          reclaim, config=VictimConfig()):
+                          mode, config=VictimConfig()):
     return run_victim_action(state, fair_share, result,
-                             num_levels=num_levels, reclaim=reclaim,
+                             num_levels=num_levels, mode=mode,
                              config=config)

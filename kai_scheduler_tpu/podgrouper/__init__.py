@@ -1,0 +1,15 @@
+"""Podgrouper — workload intake: framework CRs → gang PodGroups.
+
+Reference: ``pkg/podgrouper`` (14.8k LoC) walks pod → owner chain
+(``topowner/``) → picks a grouper plugin by the owner's GroupVersionKind
+(``podgrouper/hub/hub.go DefaultPluginsHub``) → creates/updates a
+PodGroup with minMember, queue, priority, topology constraints and
+subgroups.  This package is that catalog for the TPU framework: every
+workload kind the reference can gang-group (SURVEY.md §2.8) has a
+grouper here, keyed by ``kind``.
+"""
+from .hub import GrouperHub, PodGroupMetadata, Workload
+from .reconciler import PodGroupReconciler
+
+__all__ = ["GrouperHub", "PodGroupMetadata", "Workload",
+           "PodGroupReconciler"]

@@ -1,0 +1,49 @@
+"""Podgrouper reconciler — pods without a PodGroup get one.
+
+Reference: ``pkg/podgrouper/pod_controller.go:70`` ``PodReconciler.
+Reconcile`` — for each pod missing a PodGroup, resolve the top owner,
+pick a grouper, create/update the PodGroup CR, and annotate the pod.
+Here the reconciler sweeps the runtime ``Cluster`` hub the same way the
+controller sweeps the informer cache.
+"""
+from __future__ import annotations
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+from .hub import GrouperHub, Workload
+
+
+class PodGroupReconciler:
+    """Creates PodGroups for submitted workloads — the intake layer."""
+
+    def __init__(self, hub: GrouperHub | None = None):
+        self.hub = hub or GrouperHub()
+
+    def submit_workload(self, cluster: Cluster, workload: Workload,
+                        pods: list[apis.Pod]) -> apis.PodGroup:
+        """Workload CR + its pods → PodGroup in the cluster hub.
+
+        The reference flow (operator creates pods → webhook mutates →
+        podgrouper reconciles) collapses into one call against the hub.
+        """
+        group = self.hub.group(workload, pods)
+        cluster.submit(group, pods)
+        return group
+
+    def reconcile(self, cluster: Cluster) -> list[apis.PodGroup]:
+        """Sweep: any pod whose group is missing gets a default PodGroup
+        (grouper fallback) — mirrors the reconciler picking up bare pods."""
+        created: list[apis.PodGroup] = []
+        by_group: dict[str, list[apis.Pod]] = {}
+        for pod in cluster.pods.values():
+            if pod.group and pod.group not in cluster.pod_groups:
+                by_group.setdefault(pod.group, []).append(pod)
+        for name, pods in by_group.items():
+            workload = Workload(kind="Pod", name=name)
+            group = self.hub.group(workload, pods)
+            group.name = name  # keep the pods' existing reference
+            for p in pods:
+                p.group = name
+            cluster.submit(group, [])
+            created.append(group)
+        return created

@@ -94,11 +94,63 @@ class Cluster:
     def create_bind_request(self, br: apis.BindRequest) -> None:
         self.bind_requests[br.pod_name] = br
 
-    def bind_pod(self, pod_name: str, node_name: str) -> None:
-        """pods/binding subresource equivalent."""
+    def node_device_free(self, node_name: str) -> list[float]:
+        """Free share per accel device on a node, from pods' recorded
+        devices — the runtime equivalent of the reservation-pod device
+        bookkeeping (``binder/binding/resourcereservation``)."""
+        node = self.nodes[node_name]
+        free = [1.0] * int(round(node.allocatable.accel))
+        for pod in self.pods.values():
+            if pod.node != node_name or pod.status not in (
+                    apis.PodStatus.BOUND, apis.PodStatus.RUNNING,
+                    apis.PodStatus.RELEASING):
+                continue
+            if pod.accel_portion > 0 or pod.accel_memory_gib > 0:
+                share = (pod.accel_portion if pod.accel_portion > 0
+                         else pod.accel_memory_gib
+                         / max(node.accel_memory_gib, 1e-6))
+                for d in pod.accel_devices[:1]:
+                    if d < len(free):
+                        free[d] = max(0.0, free[d] - share)
+            else:
+                for d in pod.accel_devices:
+                    if d < len(free):
+                        free[d] = 0.0
+        return free
+
+    def bind_pod(self, pod_name: str, node_name: str,
+                 devices: list[int] | None = None) -> None:
+        """pods/binding subresource equivalent; assigns concrete accel
+        devices (the reference resolves these through the reservation
+        pod's NVML-discovered UUID — here device indices are first-class).
+        """
         pod = self.pods[pod_name]
         if node_name not in self.nodes:
             raise KeyError(f"node {node_name} not found")
+        free = self.node_device_free(node_name)
+        if pod.accel_portion > 0 or pod.accel_memory_gib > 0:
+            node = self.nodes[node_name]
+            share = (pod.accel_portion if pod.accel_portion > 0
+                     else pod.accel_memory_gib
+                     / max(node.accel_memory_gib, 1e-6))
+            if devices:
+                pod.accel_devices = devices[:1]
+            else:  # first fitting device, matching the snapshot builder
+                fits = [d for d, f in enumerate(free) if f >= share - 1e-6]
+                pod.accel_devices = fits[:1]
+            if not pod.accel_devices:
+                raise RuntimeError(
+                    f"no device on {node_name} fits share {share} for "
+                    f"{pod_name}")  # binder rolls back + backs off
+        else:
+            k = int(round(pod.resources.accel))
+            if k > 0 and not pod.accel_devices:
+                fully = [d for d, f in enumerate(free) if f >= 1.0 - 1e-6]
+                if len(fully) < k:
+                    raise RuntimeError(
+                        f"only {len(fully)} fully-free devices on "
+                        f"{node_name}, {pod_name} needs {k}")
+                pod.accel_devices = fully[:k]
         pod.node = node_name
         pod.status = apis.PodStatus.BOUND
         group = self.pod_groups.get(pod.group)

@@ -40,6 +40,11 @@ class NodeState(struct.PyTreeNode):
 
     ``free`` mirrors NodeInfo.Idle; ``releasing`` the resources of
     terminating pods (allocatable-but-not-yet); ``allocatable`` the total.
+    ``device_free`` is the per-accelerator share table (ref
+    ``GpuSharingNodeInfo`` + GPU groups): 1.0 = device fully free, partial
+    values = fractional sharing in flight; slots past a node's device
+    count stay 0.  The accel component of ``free`` equals
+    ``device_free.sum(-1)`` by construction.
     """
 
     allocatable: jax.Array   # f32 [N, R]
@@ -48,10 +53,19 @@ class NodeState(struct.PyTreeNode):
     valid: jax.Array         # bool [N]
     labels: jax.Array        # i32 [N, K]   value-id per selector key, -1 = unset
     topology: jax.Array      # i32 [N, L]   domain id per level, innermost = hostname
+    device_free: jax.Array       # f32 [N, D]  idle share per device
+    device_releasing: jax.Array  # f32 [N, D]  share being released per device
+    #: per-device memory GiB (ref MemoryOfEveryGpuOnNode) for memory-based
+    #: share requests
+    device_memory_gib: jax.Array  # f32 [N]
 
     @property
     def n(self) -> int:
         return self.valid.shape[0]
+
+    @property
+    def d(self) -> int:
+        return self.device_free.shape[1]
 
 
 class QueueState(struct.PyTreeNode):
@@ -107,6 +121,9 @@ class GangState(struct.PyTreeNode):
     task_valid: jax.Array    # bool [G, T]
     task_selector: jax.Array  # i32 [G, T, K]  required node-label value-id, -1 = any
     task_portion: jax.Array  # f32 [G, T]  fractional accel request (0 = whole)
+    #: memory-based share request GiB (0 = not memory-based); the per-node
+    #: portion is ``task_accel_mem / device_memory_gib[node]``
+    task_accel_mem: jax.Array  # f32 [G, T]
     required_level: jax.Array   # i32 [G]  topology level index, -1 = none
     preferred_level: jax.Array  # i32 [G]  topology level index, -1 = none
     #: count of this gang's bound/running (non-releasing) pods — feeds
@@ -147,6 +164,17 @@ class RunningState(struct.PyTreeNode):
     releasing: jax.Array     # bool [M]
     #: seconds since the owning gang started (for minruntime filters)
     runtime_s: jax.Array     # f32 [M]
+    #: shared device index for fractional pods (-1 = whole-device pod)
+    device: jax.Array        # i32 [M]
+    #: bitmask of occupied devices for whole-device pods (bit d set =>
+    #: device d held); 0 for fractional pods
+    devices_mask: jax.Array  # i32 [M]
+    #: accel share actually held (portion for fractional, device count for
+    #: whole) — the amount returned to ``device_free`` on eviction
+    accel_held: jax.Array    # f32 [M]
+    #: memory-based request GiB (0 = not memory-based) — consolidation
+    #: re-placement must recompute the portion for the *target* node
+    accel_mem: jax.Array     # f32 [M]
 
     @property
     def m(self) -> int:
@@ -247,9 +275,28 @@ def build_snapshot(
     node_valid = np.zeros((N,), bool)
     node_names = [n.name for n in live_nodes]
     domain_vocab: dict[tuple[int, str], int] = {}
+    # accel device table (GPU-group equivalent)
+    accel_counts = [int(round(n.allocatable.accel)) for n in live_nodes]
+    D = max(1, max(accel_counts, default=1))
+    if D > 31:
+        # whole-device occupancy is tracked as an int32 bitmask
+        # (RunningState.devices_mask); >31 devices per node would overflow
+        raise ValueError(
+            f"nodes with {D} accel devices exceed the 31-devices-per-node "
+            "limit of the device bitmask")
+    dev_free = np.zeros((N, D), np.float32)
+    dev_rel = np.zeros((N, D), np.float32)
+    node_dev_mem = np.zeros((N,), np.float32)
+    accel_mems = [n.accel_memory_gib for n, c in zip(live_nodes, accel_counts)
+                  if c > 0]
+    #: cluster-min device memory quantifies memory-based requests for
+    #: queue accounting (ref ClusterInfo.MinNodeGPUMemory)
+    min_dev_mem = min(accel_mems) if accel_mems else 16.0
     for i, n in enumerate(live_nodes):
         node_alloc[i] = n.allocatable.as_tuple()
         node_valid[i] = True
+        dev_free[i, :accel_counts[i]] = 1.0
+        node_dev_mem[i] = n.accel_memory_gib
         for ki, key in enumerate(selector_keys):
             if key in n.labels:
                 node_labels[i, ki] = value_id(key, n.labels[key])
@@ -332,6 +379,7 @@ def build_snapshot(
         task_valid=np.zeros((G, T), bool),
         task_selector=np.full((G, T, K), -1, np.int32),
         task_portion=np.zeros((G, T), np.float32),
+        task_accel_mem=np.zeros((G, T), np.float32),
         required_level=np.full((G,), -1, np.int32),
         preferred_level=np.full((G,), -1, np.int32),
         running_count=np.zeros((G,), np.int32),
@@ -358,8 +406,17 @@ def build_snapshot(
                 gk["preferred_level"][i] = topo_levels.index(tc.preferred_level)
         for t, pod in enumerate(tasks[:T]):
             gk["task_req"][i, t] = pod.resources.as_tuple()
+            # fractional / memory-based requests carry their share in the
+            # accel slot so queue & node totals stay consistent
+            # (memory-based quantified against the cluster-min device
+            # memory, ref GetTasksToAllocateInitResource MinNodeGPUMemory)
+            if pod.accel_portion > 0:
+                gk["task_req"][i, t, 0] = pod.accel_portion
+            elif pod.accel_memory_gib > 0:
+                gk["task_req"][i, t, 0] = pod.accel_memory_gib / min_dev_mem
             gk["task_valid"][i, t] = True
             gk["task_portion"][i, t] = pod.accel_portion
+            gk["task_accel_mem"][i, t] = pod.accel_memory_gib
             task_names[i][t] = pod.name
             for ki, key in enumerate(selector_keys):
                 if key in pod.node_selector:
@@ -381,6 +438,10 @@ def build_snapshot(
         valid=np.zeros((M,), bool),
         releasing=np.zeros((M,), bool),
         runtime_s=np.zeros((M,), np.float32),
+        device=np.full((M,), -1, np.int32),
+        devices_mask=np.zeros((M,), np.int32),
+        accel_held=np.zeros((M,), np.float32),
+        accel_mem=np.zeros((M,), np.float32),
     )
     running_names: list[str] = [""] * M
     if now is None:
@@ -389,7 +450,52 @@ def build_snapshot(
         grp = g_index.get(pod.group, -1)
         rk["req"][j] = pod.resources.as_tuple()
         rk["node"][j] = node_idx.get(pod.node, -1)
+        rk["accel_mem"][j] = pod.accel_memory_gib
+        if pod.accel_portion > 0:
+            rk["req"][j, 0] = pod.accel_portion
+        elif pod.accel_memory_gib > 0:
+            # a running pod's node is known: debit its *actual* per-node
+            # share so free accel stays equal to device_free.sum(-1)
+            # (pending pods use the canonical cluster-min quantification)
+            ni0 = int(rk["node"][j])
+            dm = node_dev_mem[ni0] if ni0 >= 0 else min_dev_mem
+            rk["req"][j, 0] = pod.accel_memory_gib / max(dm, 1e-6)
         rk["gang"][j] = grp
+        # --- device occupancy (GPU-group bookkeeping) --------------------
+        ni = int(rk["node"][j])
+        if ni >= 0:
+            is_frac = pod.accel_portion > 0 or pod.accel_memory_gib > 0
+            if is_frac:
+                p = (pod.accel_portion if pod.accel_portion > 0
+                     else pod.accel_memory_gib / max(node_dev_mem[ni], 1e-6))
+                if pod.accel_devices:
+                    d0 = pod.accel_devices[0]
+                else:  # deterministic first-fit, matching the binder
+                    fits = np.nonzero(dev_free[ni] >= p - 1e-6)[0]
+                    d0 = int(fits[0]) if len(fits) else 0
+                taken = min(p, dev_free[ni, d0])
+                dev_free[ni, d0] -= taken
+                if pod.status == apis.PodStatus.RELEASING:
+                    dev_rel[ni, d0] += taken
+                rk["device"][j] = d0
+                rk["accel_held"][j] = p
+            else:
+                k = int(round(pod.resources.accel))
+                if k > 0:
+                    if pod.accel_devices:
+                        devs = list(pod.accel_devices)[:k]
+                    else:
+                        devs = list(np.nonzero(
+                            dev_free[ni] >= 1.0 - 1e-6)[0][:k])
+                    mask = 0
+                    for d0 in devs:
+                        taken = min(1.0, dev_free[ni, d0])
+                        dev_free[ni, d0] -= taken
+                        if pod.status == apis.PodStatus.RELEASING:
+                            dev_rel[ni, d0] += taken
+                        mask |= 1 << int(d0)
+                    rk["devices_mask"][j] = mask
+                    rk["accel_held"][j] = float(len(devs))
         if grp >= 0:
             pg = pod_groups[grp]
             rk["queue"][j] = q_index.get(pg.queue, 0)
@@ -451,6 +557,9 @@ def build_snapshot(
             valid=jnp.asarray(node_valid),
             labels=jnp.asarray(node_labels),
             topology=jnp.asarray(node_topo),
+            device_free=jnp.asarray(dev_free, dtype),
+            device_releasing=jnp.asarray(dev_rel, dtype),
+            device_memory_gib=jnp.asarray(node_dev_mem, dtype),
         ),
         queues=QueueState(
             parent=jnp.asarray(q_parent),

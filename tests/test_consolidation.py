@@ -1,0 +1,113 @@
+"""Consolidation action tests — ref
+``actions/consolidation/consolidation_test.go``: defragment by moving
+running preemptible jobs so a pending gang fits; every victim must be
+re-placed (allPodsReallocated)."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import allocate, init_result
+from kai_scheduler_tpu.ops.victims import VictimConfig, run_victim_action
+from kai_scheduler_tpu.state import build_snapshot
+
+Vec = apis.ResourceVec
+QR = apis.QueueResource
+
+
+def fragmented_cluster():
+    """Two 4-accel nodes, each half-full with a 2-accel running pod.
+    A pending gang needing 4 accel on ONE node fits only after moving one
+    runner to the other node."""
+    nodes = [apis.Node(f"node-{i}", Vec(4.0, 64.0, 256.0)) for i in range(2)]
+    queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+    frag0 = apis.PodGroup("frag0", queue="q0", min_member=1,
+                          last_start_timestamp=0.0)
+    frag1 = apis.PodGroup("frag1", queue="q0", min_member=1,
+                          creation_timestamp=0.5, last_start_timestamp=0.5)
+    pending = apis.PodGroup("big", queue="q0", min_member=1,
+                            creation_timestamp=1.0)
+    pods = [
+        apis.Pod("f0", "frag0", resources=Vec(2.0, 1.0, 4.0),
+                 status=apis.PodStatus.RUNNING, node="node-0"),
+        apis.Pod("f1", "frag1", resources=Vec(2.0, 1.0, 4.0),
+                 status=apis.PodStatus.RUNNING, node="node-1"),
+        apis.Pod("big-0", "big", resources=Vec(4.0, 1.0, 4.0),
+                 creation_timestamp=1.0),
+    ]
+    return build_snapshot(nodes, queues, [frag0, frag1, pending], pods,
+                          now=100.0)
+
+
+def run_consolidate(state, num_levels=1, **cfg):
+    fair_share = drf.set_fair_share(state, num_levels=num_levels)
+    return run_victim_action(
+        state, fair_share, init_result(state), num_levels=num_levels,
+        mode="consolidate", config=VictimConfig(**cfg))
+
+
+class TestConsolidation:
+    def test_moves_runner_to_fit_pending_gang(self):
+        state, index = fragmented_cluster()
+        # sanity: plain allocate cannot place the 4-accel task
+        fair_share = drf.set_fair_share(state, num_levels=1)
+        plain = allocate(state, fair_share, num_levels=1)
+        big = index.gang_names.index("big")
+        assert not bool(plain.allocated[big])
+
+        res = run_consolidate(state)
+        assert bool(res.allocated[big])
+        assert bool(res.pipelined[big, 0])
+        victims = np.asarray(res.victim)
+        moves = np.asarray(res.victim_move)
+        assert victims.sum() == 1                 # exactly one runner moved
+        vi = int(np.argmax(victims))
+        assert moves[vi] >= 0                     # and it has a new home
+        # the move target is the *other* node than the preemptor's
+        big_node = int(np.asarray(res.placements)[big, 0])
+        assert moves[vi] != big_node
+
+    def test_no_consolidation_when_victims_cannot_be_replaced(self):
+        """Full cluster: evicting a runner leaves nowhere to re-place it."""
+        nodes = [apis.Node("node-0", Vec(4.0, 64.0, 256.0))]
+        queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+        frag = apis.PodGroup("frag", queue="q0", min_member=1,
+                             last_start_timestamp=0.0)
+        pending = apis.PodGroup("big", queue="q0", min_member=1,
+                                creation_timestamp=1.0)
+        pods = [
+            apis.Pod("f0", "frag", resources=Vec(2.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-0"),
+            apis.Pod("big-0", "big", resources=Vec(4.0, 1.0, 4.0)),
+        ]
+        state, index = build_snapshot(nodes, queues, [frag, pending], pods,
+                                      now=100.0)
+        res = run_consolidate(state)
+        assert not bool(res.allocated[index.gang_names.index("big")])
+        assert int(np.asarray(res.victim).sum()) == 0
+
+    def test_nonpreemptible_pending_gang_not_served(self):
+        state, index = fragmented_cluster()
+        groups = list(index.gang_names)
+        # rebuild with a non-preemptible pending gang
+        nodes = [apis.Node(f"node-{i}", Vec(4.0, 64.0, 256.0))
+                 for i in range(2)]
+        queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+        frag0 = apis.PodGroup("frag0", queue="q0", min_member=1,
+                              last_start_timestamp=0.0)
+        frag1 = apis.PodGroup("frag1", queue="q0", min_member=1,
+                              last_start_timestamp=0.0)
+        pending = apis.PodGroup(
+            "big", queue="q0", min_member=1,
+            preemptibility=apis.Preemptibility.NON_PREEMPTIBLE)
+        pods = [
+            apis.Pod("f0", "frag0", resources=Vec(2.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-0"),
+            apis.Pod("f1", "frag1", resources=Vec(2.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-1"),
+            apis.Pod("big-0", "big", resources=Vec(4.0, 1.0, 4.0)),
+        ]
+        state, index = build_snapshot(nodes, queues,
+                                      [frag0, frag1, pending], pods,
+                                      now=100.0)
+        res = run_consolidate(state)
+        assert not bool(res.allocated[index.gang_names.index("big")])

@@ -1,0 +1,154 @@
+"""Fractional / device-group sharing tests — ref
+``actions/allocate/allocateFractionalGpu_test.go`` and
+``allocateGpuMemory_test.go`` scenarios plus gpupack/gpuspread ordering."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.ops.scoring import PlacementConfig
+from kai_scheduler_tpu.state import build_snapshot
+
+Vec = apis.ResourceVec
+QR = apis.QueueResource
+
+
+def run_allocate(nodes, groups, pods, *, device_pack=True):
+    queues = [apis.Queue("q0", accel=QR(quota=1000.0))]
+    state, index = build_snapshot(nodes, queues, groups, pods)
+    fair_share = drf.set_fair_share(state, num_levels=1)
+    cfg = AllocateConfig(
+        placement=PlacementConfig(device_pack=device_pack))
+    res = allocate(state, fair_share, num_levels=1, config=cfg)
+    return res, state, index
+
+
+def gang(name, n_pods, *, portion=0.0, mem=0.0, accel=0.0, ts=0.0):
+    g = apis.PodGroup(name, queue="q0", min_member=n_pods,
+                      creation_timestamp=ts)
+    pods = [apis.Pod(f"{name}-p{i}", name,
+                     resources=Vec(accel, 1.0, 1.0),
+                     accel_portion=portion, accel_memory_gib=mem,
+                     creation_timestamp=ts)
+            for i in range(n_pods)]
+    return g, pods
+
+
+class TestFractional:
+    def test_two_halves_share_one_device(self):
+        nodes = [apis.Node("node-0", Vec(2.0, 64.0, 256.0))]
+        g0, p0 = gang("g0", 2, portion=0.5)
+        res, state, index = run_allocate(nodes, [g0], p0)
+        gi = index.gang_names.index("g0")
+        assert bool(res.allocated[gi])
+        devs = np.asarray(res.placement_device)[gi, :2]
+        assert (devs >= 0).all()
+        # gpupack default: both halves packed onto the SAME device
+        assert devs[0] == devs[1]
+        # device table: one device fully used, one untouched
+        df = np.sort(np.asarray(res.device_free)[0])
+        np.testing.assert_allclose(df, [0.0, 1.0], atol=1e-5)
+
+    def test_gpuspread_puts_fractions_on_different_devices(self):
+        nodes = [apis.Node("node-0", Vec(2.0, 64.0, 256.0))]
+        g0, p0 = gang("g0", 2, portion=0.5)
+        res, state, index = run_allocate(nodes, [g0], p0, device_pack=False)
+        devs = np.asarray(res.placement_device)[0, :2]
+        assert devs[0] != devs[1]
+
+    def test_fraction_too_big_for_any_device_fails(self):
+        # 0.6 + 0.6 > 1.0: second pod cannot share the first's device and
+        # the node has only one device.
+        nodes = [apis.Node("node-0", Vec(1.0, 64.0, 256.0))]
+        g0, p0 = gang("g0", 2, portion=0.6)
+        res, state, index = run_allocate(nodes, [g0], p0)
+        assert not bool(res.allocated[0])
+
+    def test_whole_device_task_needs_fully_free_device(self):
+        # devices at 0.5 free each: a whole-device task must NOT fit even
+        # though total free accel = 1.0
+        nodes = [apis.Node("node-0", Vec(2.0, 64.0, 256.0))]
+        frac = apis.PodGroup("frac", queue="q0", min_member=2,
+                             last_start_timestamp=0.0)
+        frac_pods = [
+            apis.Pod(f"f{i}", "frac", resources=Vec(0.0, 1.0, 1.0),
+                     accel_portion=0.5, status=apis.PodStatus.RUNNING,
+                     node="node-0", accel_devices=[i])
+            for i in range(2)]
+        whole, whole_pods = gang("whole", 1, accel=1.0, ts=1.0)
+        res, state, index = run_allocate(nodes, [frac, whole],
+                                         frac_pods + whole_pods)
+        wi = index.gang_names.index("whole")
+        assert not bool(res.allocated[wi])
+
+    def test_sharing_order_prefers_used_device_node(self):
+        # node-0 has a half-used device; node-1 all free.  A new 0.5
+        # fraction should go to node-0's shared device (gpusharingorder
+        # band + gpupack), keeping node-1's devices whole.
+        nodes = [apis.Node(f"node-{i}", Vec(2.0, 64.0, 256.0))
+                 for i in range(2)]
+        frac = apis.PodGroup("frac", queue="q0", min_member=1,
+                             last_start_timestamp=0.0)
+        frac_pods = [apis.Pod("f0", "frac", resources=Vec(0.0, 1.0, 1.0),
+                              accel_portion=0.5,
+                              status=apis.PodStatus.RUNNING,
+                              node="node-0", accel_devices=[0])]
+        newg, new_pods = gang("new", 1, portion=0.5, ts=1.0)
+        res, state, index = run_allocate(nodes, [frac, newg],
+                                         frac_pods + new_pods)
+        ni = index.gang_names.index("new")
+        assert bool(res.allocated[ni])
+        node = int(np.asarray(res.placements)[ni, 0])
+        dev = int(np.asarray(res.placement_device)[ni, 0])
+        assert index.node_names[node] == "node-0"
+        assert dev == 0                      # joined the shared device
+
+
+class TestMemoryBased:
+    def test_memory_request_converts_to_portion(self):
+        # 8 GiB of a 16 GiB device = 0.5 portion; two such pods share one
+        # device.
+        nodes = [apis.Node("node-0", Vec(1.0, 64.0, 256.0),
+                           accel_memory_gib=16.0)]
+        g0, p0 = gang("g0", 2, mem=8.0)
+        res, state, index = run_allocate(nodes, [g0], p0)
+        assert bool(res.allocated[0])
+        df = np.asarray(res.device_free)[0]
+        np.testing.assert_allclose(df[0], 0.0, atol=1e-5)
+
+    def test_memory_request_respects_node_device_memory(self):
+        # 12 GiB request: fits a 16 GiB device (0.75) but not an 8 GiB
+        # one — node choice must respect per-node device memory.
+        nodes = [
+            apis.Node("small", Vec(1.0, 64.0, 256.0), accel_memory_gib=8.0),
+            apis.Node("big", Vec(1.0, 64.0, 256.0), accel_memory_gib=16.0),
+        ]
+        g0, p0 = gang("g0", 1, mem=12.0)
+        res, state, index = run_allocate(nodes, [g0], p0)
+        assert bool(res.allocated[0])
+        node = int(np.asarray(res.placements)[0, 0])
+        assert index.node_names[node] == "big"
+
+
+class TestEndToEndFraction:
+    def test_bind_carries_device_group(self):
+        from kai_scheduler_tpu.binder import Binder
+        from kai_scheduler_tpu.framework import Scheduler, SchedulerConfig
+        from kai_scheduler_tpu.framework.session import SessionConfig
+        from kai_scheduler_tpu.runtime.cluster import Cluster
+
+        nodes = [apis.Node("node-0", Vec(2.0, 64.0, 256.0))]
+        queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+        g0, p0 = gang("g0", 2, portion=0.5)
+        cluster = Cluster.from_objects(nodes, queues, [g0], p0)
+        sched = Scheduler(SchedulerConfig(
+            actions=("allocate",), session=SessionConfig(num_levels=1)))
+        r = sched.run_once(cluster)
+        assert len(r.bind_requests) == 2
+        for br in r.bind_requests:
+            assert br.received_resource_type == \
+                apis.ReceivedResourceType.FRACTION
+            assert len(br.selected_accel_groups) == 1
+        Binder().reconcile(cluster)
+        devs = {cluster.pods[p.name].accel_devices[0] for p in p0}
+        assert len(devs) == 1            # packed onto one shared device

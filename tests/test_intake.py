@@ -1,0 +1,197 @@
+"""Podgrouper + admission tests — ref ``pkg/podgrouper`` plugin tests
+(one per workload kind) and ``pkg/admission`` webhook tests."""
+import pytest
+
+from kai_scheduler_tpu.admission import (AdmissionError, PodMutator,
+                                         PodValidator)
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.podgrouper import (GrouperHub, PodGroupReconciler,
+                                          Workload)
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+Vec = apis.ResourceVec
+
+
+def pods_for(name, n):
+    return [apis.Pod(f"{name}-{i}", "", resources=Vec(1.0, 1.0, 1.0))
+            for i in range(n)]
+
+
+class TestGroupers:
+    def setup_method(self):
+        self.hub = GrouperHub()
+
+    def test_catalog_covers_reference_kinds(self):
+        # the workload-kind catalog from SURVEY.md §2.8
+        for kind in ["Pod", "Job", "CronJob", "Deployment", "RunaiJob",
+                     "AMLJob", "PyTorchJob", "TFJob", "XGBoostJob",
+                     "MPIJob", "JAXJob", "Notebook", "RayCluster",
+                     "RayJob", "RayService", "SparkApplication", "JobSet",
+                     "LeaderWorkerSet", "PodGangSet", "Revision",
+                     "SpotRequest"]:
+            assert kind in self.hub.kinds(), kind
+
+    def test_pytorch_job_replicas(self):
+        w = Workload(kind="PyTorchJob", name="train",
+                     labels={"kai.scheduler/queue": "team-a"},
+                     spec={"pytorchReplicaSpecs": {
+                         "Master": {"replicas": 1},
+                         "Worker": {"replicas": 3}}})
+        group = self.hub.group(w, pods_for("train", 4))
+        assert group.min_member == 4
+        assert group.queue == "team-a"
+        assert {s.name for s in group.sub_groups} == {"master", "worker"}
+
+    def test_jax_job_min_available_override(self):
+        w = Workload(kind="JAXJob", name="train",
+                     spec={"jaxReplicaSpecs": {"Worker": {"replicas": 8}},
+                           "runPolicy": {"minAvailable": 6}})
+        group = self.hub.group(w, pods_for("train", 8))
+        assert group.min_member == 6      # elastic: 6 of 8 suffice
+
+    def test_ray_cluster_min_replicas(self):
+        w = Workload(kind="RayCluster", name="rc",
+                     spec={"workerGroupSpecs": [
+                         {"groupName": "small", "replicas": 4,
+                          "minReplicas": 2},
+                         {"groupName": "big", "replicas": 2}]})
+        group = self.hub.group(w, pods_for("rc", 7))
+        assert group.min_member == 1 + 2 + 2    # head + mins
+
+    def test_jobset_replicated_jobs(self):
+        w = Workload(kind="JobSet", name="js",
+                     spec={"replicatedJobs": [
+                         {"name": "a", "replicas": 2,
+                          "template": {"spec": {"parallelism": 3}}},
+                         {"name": "b", "replicas": 1}]})
+        group = self.hub.group(w, pods_for("js", 7))
+        assert group.min_member == 7
+
+    def test_leader_worker_set(self):
+        w = Workload(kind="LeaderWorkerSet", name="lws",
+                     spec={"leaderWorkerTemplate": {"size": 5}})
+        group = self.hub.group(w, pods_for("lws", 5))
+        assert group.min_member == 5
+
+    def test_spark_driver_plus_executors(self):
+        w = Workload(kind="SparkApplication", name="spark",
+                     spec={"executor": {"instances": 4}})
+        group = self.hub.group(w, pods_for("spark", 5))
+        assert group.min_member == 5
+
+    def test_notebook_nonpreemptible(self):
+        w = Workload(kind="Notebook", name="nb")
+        group = self.hub.group(w, pods_for("nb", 1))
+        assert group.preemptibility == apis.Preemptibility.NON_PREEMPTIBLE
+
+    def test_owner_chain_resolution(self):
+        job = Workload(kind="Job", name="step",
+                       spec={"parallelism": 2},
+                       owner=Workload(kind="CronJob", name="nightly",
+                                      spec={"jobTemplate": {
+                                          "spec": {"parallelism": 2}}}))
+        group = self.hub.group(job, pods_for("j", 2))
+        assert "cronjob" in group.name
+
+    def test_skip_top_owner(self):
+        # Argo Workflow owns a Job: grouping stops at the Job
+        job = Workload(kind="Job", name="wf-step", spec={"parallelism": 3},
+                       owner=Workload(kind="Workflow", name="wf"))
+        group = self.hub.group(job, pods_for("j", 3))
+        assert group.min_member == 3
+        assert "job" in group.name
+
+    def test_topology_annotations(self):
+        w = Workload(kind="Job", name="j", spec={"parallelism": 2},
+                     annotations={
+                         "kai.scheduler/topology-required-level": "rack"})
+        group = self.hub.group(w, pods_for("j", 2))
+        assert group.topology_constraint.required_level == "rack"
+
+    def test_unknown_kind_falls_back_to_default(self):
+        w = Workload(kind="SomethingNew", name="x")
+        group = self.hub.group(w, pods_for("x", 1))
+        assert group.min_member == 1
+
+
+class TestReconciler:
+    def test_submit_workload_creates_group_and_pods(self):
+        cluster = Cluster()
+        rec = PodGroupReconciler()
+        pods = pods_for("train", 4)
+        w = Workload(kind="PyTorchJob", name="train",
+                     spec={"pytorchReplicaSpecs": {
+                         "Worker": {"replicas": 4}}})
+        group = rec.submit_workload(cluster, w, pods)
+        assert group.name in cluster.pod_groups
+        assert all(p.group == group.name for p in pods)
+        assert len(cluster.pods) == 4
+
+    def test_orphan_pods_get_group(self):
+        cluster = Cluster()
+        pod = apis.Pod("orphan", "some-group",
+                       resources=Vec(1.0, 1.0, 1.0))
+        cluster.pods[pod.name] = pod
+        created = PodGroupReconciler().reconcile(cluster)
+        assert len(created) == 1
+        assert "some-group" in cluster.pod_groups
+
+
+class TestAdmission:
+    def test_mutator_translates_fraction_annotation(self):
+        pod = apis.Pod("p", "g")
+        PodMutator().mutate(pod, annotations={
+            "kai.scheduler/accel-fraction": "0.5"})
+        assert pod.accel_portion == 0.5
+
+    def test_mutator_node_selector(self):
+        pod = apis.Pod("p", "g")
+        PodMutator().mutate(pod, annotations={
+            "kai.scheduler/node-selector": "pool=a, zone=z1"})
+        assert pod.node_selector == {"pool": "a", "zone": "z1"}
+
+    def test_validator_rejects_bad_fractions(self):
+        v = PodValidator()
+        with pytest.raises(AdmissionError):
+            v.validate(apis.Pod("p", "g", accel_portion=1.5))
+        with pytest.raises(AdmissionError):
+            v.validate(apis.Pod("p", "g", accel_portion=-0.1))
+        with pytest.raises(AdmissionError):
+            v.validate(apis.Pod("p", "g", accel_portion=0.5,
+                                accel_memory_gib=8.0))
+        with pytest.raises(AdmissionError):
+            v.validate(apis.Pod("p", "g", resources=Vec(1.0, 1, 1),
+                                accel_portion=0.5))
+        with pytest.raises(AdmissionError):
+            v.validate(apis.Pod("p", "g", resources=Vec(1.5, 1, 1)))
+        v.validate(apis.Pod("p", "g", accel_portion=0.5))  # ok
+        v.validate(apis.Pod("p", "g", resources=Vec(2.0, 1, 1)))  # ok
+
+
+class TestIntakeToScheduleFlow:
+    def test_pytorch_job_schedules_as_gang(self):
+        from kai_scheduler_tpu.binder import Binder
+        from kai_scheduler_tpu.framework import Scheduler, SchedulerConfig
+        from kai_scheduler_tpu.framework.session import SessionConfig
+
+        cluster = Cluster.from_objects(
+            [apis.Node("node-0", Vec(8.0, 64.0, 256.0))],
+            [apis.Queue("team-a", accel=apis.QueueResource(quota=8.0))],
+            [], [])
+        rec = PodGroupReconciler()
+        w = Workload(kind="PyTorchJob", name="train",
+                     labels={"kai.scheduler/queue": "team-a"},
+                     spec={"pytorchReplicaSpecs": {
+                         "Master": {"replicas": 1},
+                         "Worker": {"replicas": 3}}})
+        pods = [apis.Pod(f"train-{i}", "", resources=Vec(2.0, 1.0, 4.0))
+                for i in range(4)]
+        rec.submit_workload(cluster, w, pods)
+
+        sched = Scheduler(SchedulerConfig(
+            actions=("allocate",), session=SessionConfig(num_levels=1)))
+        r = sched.run_once(cluster)
+        assert len(r.bind_requests) == 4
+        Binder().reconcile(cluster)
+        assert all(p.status == apis.PodStatus.BOUND
+                   for p in cluster.pods.values())

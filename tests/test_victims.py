@@ -53,7 +53,7 @@ def run_reclaim(state, num_levels=1, **cfg):
     fair_share = drf.set_fair_share(state, num_levels=num_levels)
     res = run_victim_action(
         state, fair_share, init_result(state), num_levels=num_levels,
-        reclaim=True, config=VictimConfig(**cfg))
+        mode="reclaim", config=VictimConfig(**cfg))
     return res, fair_share
 
 
@@ -149,7 +149,7 @@ def run_preempt(state, num_levels=1, **cfg):
     fair_share = drf.set_fair_share(state, num_levels=num_levels)
     return run_victim_action(
         state, fair_share, init_result(state), num_levels=num_levels,
-        reclaim=False, config=VictimConfig(**cfg))
+        mode="preempt", config=VictimConfig(**cfg))
 
 
 class TestPreempt:
