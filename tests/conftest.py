@@ -3,12 +3,22 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on 8 virtual CPU devices (the driver separately dry-runs the
 multi-chip path via ``__graft_entry__.dryrun_multichip``).
+
+The CI image's sitecustomize registers the TPU-tunnel PJRT plugin and
+forces ``jax_platforms="axon,cpu"`` through ``jax.config.update`` — env
+vars alone cannot undo that, so we update the config here (before any
+backend initialisation) to pin tests to CPU.  ``XLA_FLAGS`` must be in
+the environment before the CPU backend first initialises.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
