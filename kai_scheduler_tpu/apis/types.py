@@ -256,6 +256,9 @@ class BindRequest:
     received_resource_type: ReceivedResourceType = ReceivedResourceType.REGULAR
     received_accel_count: int = 0
     received_accel_portion: float = 0.0
+    #: memory-based share request, GiB — makes the bind record
+    #: self-contained for memory-based fractions (ref ReceivedGpuMemory)
+    received_accel_memory_gib: float = 0.0
     #: device indices chosen by the scheduler (fractional: the shared
     #: device; whole: filled by the binder) — ref SelectedGPUGroups
     selected_accel_groups: list[int] = dataclasses.field(default_factory=list)
@@ -272,6 +275,11 @@ class Eviction:
     pod_name: str
     group: str
     reason: str = ""
+    #: consolidation move target: the victim was verified to fit on this
+    #: node and gets a pipelined rebind there (ref the consolidation
+    #: Statement evicting and re-pipelining victims atomically,
+    #: ``consolidation.go`` allPodsReallocated).  None = plain eviction.
+    move_to: str | None = None
 
 
 # ---------------------------------------------------------------------------

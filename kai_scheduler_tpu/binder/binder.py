@@ -84,7 +84,17 @@ class Binder:
             if br.phase != "Pending":
                 continue
             pod = cluster.pods.get(br.pod_name)
-            if pod is None or pod.status != apis.PodStatus.PENDING:
+            if pod is None or pod.status in (apis.PodStatus.SUCCEEDED,
+                                             apis.PodStatus.FAILED):
+                br.phase = "Failed"
+                result.failed.append(br.pod_name)
+                continue
+            if pod.status == apis.PodStatus.RELEASING:
+                # pipelined rebind: the old pod is still vacating; wait
+                # for its restart (consolidation move path)
+                result.retrying.append(br.pod_name)
+                continue
+            if pod.status != apis.PodStatus.PENDING:
                 br.phase = "Failed"
                 result.failed.append(br.pod_name)
                 continue

@@ -38,6 +38,9 @@ class CycleResult:
 
     bind_requests: list[apis.BindRequest] = dataclasses.field(default_factory=list)
     evictions: list[apis.Eviction] = dataclasses.field(default_factory=list)
+    #: pipelined rebinds for consolidation-moved victims
+    move_bind_requests: list[apis.BindRequest] = dataclasses.field(
+        default_factory=list)
     #: the on-device commit set threaded through the action pipeline
     tensors: AllocationResult | None = None
     #: action name -> wall seconds (ref per-action latency metrics)
@@ -153,10 +156,20 @@ class Scheduler:
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
         result.bind_requests = session.bind_requests_from(result.tensors)
-        result.evictions = session.evictions_from(result.tensors.victim)
+        result.evictions = session.evictions_from(
+            result.tensors.victim, result.tensors.victim_move)
         for br in result.bind_requests:
             cluster.create_bind_request(br)
         for ev in result.evictions:
-            cluster.evict_pod(ev.pod_name)
+            # consolidation victims restart and get a pipelined rebind on
+            # their verified target node — evicted, not lost
+            # (ref consolidation.go allPodsReallocated + stmt pipelining)
+            cluster.evict_pod(ev.pod_name, restart=ev.move_to is not None)
+            if ev.move_to is not None:
+                pod = cluster.pods.get(ev.pod_name)
+                if pod is not None:
+                    rebind = session.move_bind_request(pod, ev.move_to)
+                    result.move_bind_requests.append(rebind)
+                    cluster.create_bind_request(rebind)
         result.session_seconds = time.perf_counter() - t0
         return result

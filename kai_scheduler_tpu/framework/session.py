@@ -107,6 +107,7 @@ class Session:
                         apis.ReceivedResourceType.FRACTION if is_frac
                         else apis.ReceivedResourceType.REGULAR),
                     received_accel_portion=portion,
+                    received_accel_memory_gib=float(mems[gi, ti]),
                     received_accel_count=(
                         0 if is_frac else int(round(float(reqs[gi, ti, 0])))),
                     selected_accel_groups=[dev] if dev >= 0 else [],
@@ -114,14 +115,45 @@ class Session:
                 ))
         return out
 
-    def evictions_from(self, victim_mask) -> list[apis.Eviction]:
-        """Victim tensor [M] → Eviction objects (``cache.Evict`` analogue)."""
+    def evictions_from(self, victim_mask,
+                       victim_move=None) -> list[apis.Eviction]:
+        """Victim tensor [M] → Eviction objects (``cache.Evict`` analogue).
+
+        ``victim_move`` ([M] node index, -1 = none) attaches the
+        consolidation move target so the commit path can emit the
+        pipelined rebind for the relocated pod.
+        """
         mask = np.asarray(victim_mask)
+        moves = None if victim_move is None else np.asarray(victim_move)
         gangs = np.asarray(self.state.running.gang)
         out: list[apis.Eviction] = []
         for mi, name in enumerate(self.index.running_pod_names):
             if mi < len(mask) and mask[mi] and name:
                 gi = int(gangs[mi])
                 group = self.index.gang_names[gi] if 0 <= gi < len(self.index.gang_names) else ""
-                out.append(apis.Eviction(pod_name=name, group=group))
+                move_to = None
+                if moves is not None and mi < len(moves) and moves[mi] >= 0:
+                    move_to = self.index.node_names[int(moves[mi])]
+                out.append(apis.Eviction(pod_name=name, group=group,
+                                         move_to=move_to))
         return out
+
+    def move_bind_request(self, pod: apis.Pod,
+                          target_node: str) -> apis.BindRequest:
+        """The pipelined rebind for a consolidation-moved victim: binds
+        once the old pod has vacated and its replacement is pending —
+        the persistent equivalent of the reference's pipelined victim
+        re-allocation inside the committed Statement."""
+        is_frac = pod.accel_portion > 0 or pod.accel_memory_gib > 0
+        return apis.BindRequest(
+            pod_name=pod.name,
+            selected_node=target_node,
+            received_resource_type=(
+                apis.ReceivedResourceType.FRACTION if is_frac
+                else apis.ReceivedResourceType.REGULAR),
+            received_accel_portion=pod.accel_portion,
+            received_accel_memory_gib=pod.accel_memory_gib,
+            received_accel_count=(
+                0 if is_frac else int(round(pod.resources.accel))),
+            backoff_limit=self.config.default_bind_backoff_limit,
+        )

@@ -69,8 +69,17 @@ class AllocationResult(struct.PyTreeNode):
     pipelined: jax.Array      # bool [G, T] placed onto releasing resources
     allocated: jax.Array      # bool [G]    gang committed this cycle
     attempted: jax.Array      # bool [G]    gang was popped and tried
-    free: jax.Array           # f32 [N, R]  idle+releasing pool after commits
+    free: jax.Array           # f32 [N, R]  *idle* pool after commits (may dip
+    #                           negative where pipelined tasks drew on
+    #                           releasing capacity; feasibility always checks
+    #                           idle+releasing sums)
     device_free: jax.Array    # f32 [N, D]  per-device share pool
+    #: capacity freed by THIS cycle's victims — it is releasing, not idle
+    #: (the pods have not terminated), so tasks placed on it pipeline.
+    #: The tensor equivalent of Statement.Evict flipping a pod to
+    #: Releasing status mid-cycle (``framework/statement.go``).
+    releasing_extra: jax.Array         # f32 [N, R]
+    device_releasing_extra: jax.Array  # f32 [N, D]
     queue_allocated: jax.Array  # f32 [Q, R]
     queue_allocated_nonpreemptible: jax.Array  # f32 [Q, R]
     #: running pods evicted this cycle (victims of reclaim/preempt/
@@ -95,6 +104,8 @@ def init_result(state: ClusterState) -> AllocationResult:
         attempted=jnp.zeros((G,), bool),
         free=n.free,
         device_free=n.device_free,
+        releasing_extra=jnp.zeros_like(n.free),
+        device_releasing_extra=jnp.zeros_like(n.device_free),
         queue_allocated=q.allocated,
         queue_allocated_nonpreemptible=q.allocated_nonpreemptible,
         victim=jnp.zeros((state.running.m,), bool),
@@ -154,10 +165,19 @@ def _attempt_gang_in_domain(
         num_levels: int, config: AllocateConfig,
         domain_mask: jax.Array,        # bool [N] — allowed nodes
         pref_doms: jax.Array,          # i32 [N]  preferred-level domain ids
-        has_pref: jax.Array):          # bool []
+        has_pref: jax.Array,           # bool []
+        extra_releasing: jax.Array,        # f32 [N, R] victim-freed capacity
+        extra_device_releasing: jax.Array  # f32 [N, D]
+):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
-    fractional-device path (``gpu_sharing/gpu_sharing.go:20-105``)."""
+    fractional-device path (``gpu_sharing/gpu_sharing.go:20-105``).
+
+    ``extra_releasing`` joins the snapshot's releasing pool for the
+    pipeline-fit check, so tasks landing on victim-freed capacity are
+    marked pipelined (bind later) while tasks on genuinely idle capacity
+    bind immediately — matching ``stmt.Allocate`` vs ``stmt.Pipeline``.
+    """
     g = state.gangs
     n = state.nodes
     T = g.t
@@ -189,7 +209,8 @@ def _attempt_gang_in_domain(
             free=free_l, device_free=dev_l) & domain_mask
         fit_pipe = feasible_nodes(
             n, req, task_sel[t], task_portion[t], task_mem[t],
-            free=free_l, device_free=dev_l,
+            free=free_l + extra_releasing,
+            device_free=dev_l + extra_device_releasing,
             include_releasing=True) & domain_mask                      # [N]
         # preferred-level locality band (topology plugin node scoring):
         # stick with the domain of the gang's first-placed task.
@@ -207,7 +228,8 @@ def _attempt_gang_in_domain(
 
         # ---- device bookkeeping (GPU-group allocation) ------------------
         dev_row = dev_l[node]                                          # [D]
-        dev_rel_row = n.device_releasing[node]
+        dev_rel_row = (n.device_releasing[node]
+                       + extra_device_releasing[node])
         p = portion_n[node]
         # fractional: GpuOrderFn pick among idle-fitting devices; a
         # pipelined fraction may dip into releasing share (bounded
@@ -265,7 +287,9 @@ def _attempt_gang_in_domain(
 def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   free: jax.Array, device_free: jax.Array,
                   q_alloc: jax.Array, q_alloc_np: jax.Array,
-                  num_levels: int, config: AllocateConfig):
+                  num_levels: int, config: AllocateConfig,
+                  extra_releasing: jax.Array | None = None,
+                  extra_device_releasing: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -281,6 +305,10 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     T = g.t
     L = n.topology.shape[1]
     N = n.n
+    if extra_releasing is None:
+        extra_releasing = jnp.zeros_like(free)
+    if extra_device_releasing is None:
+        extra_device_releasing = jnp.zeros_like(device_free)
 
     pl = g.preferred_level[gang_idx]
     has_pref = pl >= 0
@@ -292,14 +320,15 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     def unconstrained(_):
         return _attempt_gang_in_domain(
             state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-            num_levels, config, n.valid, pref_doms, has_pref)
+            num_levels, config, n.valid, pref_doms, has_pref,
+            extra_releasing, extra_device_releasing)
 
     def constrained(_):
         doms = n.topology[:, jnp.maximum(rl, 0)]               # [N]
         # domain ids are globally dense over (level, path) — bound N*L
         D = N * L
         dom_seg = jnp.where(n.valid & (doms >= 0), doms, D)
-        avail = free + n.releasing
+        avail = free + n.releasing + extra_releasing
         agg = jax.ops.segment_sum(
             jnp.where(n.valid[:, None], avail, 0.0), dom_seg,
             num_segments=D + 1)[:D]                            # [D, R]
@@ -328,7 +357,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
             d = jnp.argmin(jnp.where(cand, dom_key, jnp.inf))
             out = _attempt_gang_in_domain(
                 state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-                num_levels, config, doms == d, pref_doms, has_pref)
+                num_levels, config, doms == d, pref_doms, has_pref,
+                extra_releasing, extra_device_releasing)
             success = out[-1]
             best = jax.tree.map(
                 lambda nw, old: jnp.where(success, nw, old), out, best)
@@ -385,7 +415,8 @@ def allocate(
             free, dev, qa, qan = args
             free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
                 _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
-                              config)
+                              config, init.releasing_extra,
+                              init.device_releasing_extra)
             # checkpoint/rollback: keep post-gang state only on success
             sel = lambda a, b: jnp.where(success, a, b)
             return (sel(free2, free), sel(dev2, dev), sel(qa2, qa),

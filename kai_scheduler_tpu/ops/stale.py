@@ -51,10 +51,12 @@ def stale_gang_eviction(
     chain = _chain_membership(state.queues.parent, num_levels)
     freed_nodes, freed_dev, freed_q, freed_q_np = freed_by_mask(
         state, victims, chain)
+    # the evicted pods' capacity is releasing (they have not terminated) —
+    # tasks placed on it must pipeline, so it joins releasing_extra
     return result.replace(
         victim=result.victim | victims,
-        free=result.free + freed_nodes,
-        device_free=result.device_free + freed_dev,
+        releasing_extra=result.releasing_extra + freed_nodes,
+        device_releasing_extra=result.device_releasing_extra + freed_dev,
         queue_allocated=jnp.maximum(result.queue_allocated - freed_q, 0.0),
         queue_allocated_nonpreemptible=jnp.maximum(
             result.queue_allocated_nonpreemptible - freed_q_np, 0.0),

@@ -183,6 +183,7 @@ def _rank_eviction_units(
     cand: jax.Array,             # bool [M]
     queue_allocated: jax.Array,  # f32 [Q, R]
     fair_share: jax.Array,       # f32 [Q, R]
+    already_victim: jax.Array,   # bool [M]  victims accumulated this cycle
 ):
     """Assign every candidate pod a global eviction-unit rank.
 
@@ -230,12 +231,20 @@ def _rank_eviction_units(
     seq = pos - first_pos[jnp.minimum(gang_of_pod, G - 1)]      # [M]
 
     # ---- unit ids --------------------------------------------------------
-    # Surplus is sized from the gang's *active* pod count (running_count),
-    # not the candidate count: pods excluded from candidacy (unknown node,
-    # already victims) still hold the gang above minMember
-    # (ref GetTasksToEvict sizes units from active allocated tasks).
+    # Surplus is sized from the gang's *effective* active pod count:
+    # running_count minus pods already victimised by earlier actions this
+    # cycle — the reference's Statement.Evict updates the active-task
+    # counts GetTasksToEvict reads, so a gang reclaimed down to minMember
+    # by one action is NOT elastic-shrinkable again by the next; the
+    # final unit (whole remaining gang) triggers at the right threshold.
+    # Pods excluded from candidacy for other reasons (unknown node) still
+    # hold the gang above minMember.
+    victims_in_gang = jax.ops.segment_sum(
+        (already_victim & (r.gang >= 0)).astype(jnp.int32),
+        jnp.where(r.gang >= 0, r.gang, G), num_segments=G + 1)[:G]
+    effective_active = g.running_count - victims_in_gang        # [G]
     surplus = jnp.clip(
-        g.running_count - g.min_member, 0, pods_per_gang)       # [G]
+        effective_active - g.min_member, 0, pods_per_gang)      # [G]
     units_per_gang = jnp.where(
         victim_gang, surplus + (pods_per_gang > surplus), 0)    # [G]
     units_by_rank = units_per_gang[rank_gang]                   # [G]
@@ -283,6 +292,8 @@ def solve_for_preemptor(
     g, q, n, r = state.gangs, state.queues, state.nodes, state.running
     free = result.free
     dev = result.device_free
+    extra = result.releasing_extra
+    extra_dev = result.device_releasing_extra
     qa = result.queue_allocated
     qan = result.queue_allocated_nonpreemptible
     queue = g.queue[gang_idx]
@@ -311,7 +322,12 @@ def solve_for_preemptor(
         state, gang_idx, mode=mode, already_victim=result.victim)
     gate &= jnp.any(cand)
 
-    unit_rank, num_units = _rank_eviction_units(state, cand, qa, fair_share)
+    # moved (consolidated) victims stay active gang members — they restart
+    # on their target node — so only *removed* victims shrink the gang's
+    # effective active count for unit sizing
+    removed_victims = result.victim & (result.victim_move < 0)
+    unit_rank, num_units = _rank_eviction_units(
+        state, cand, qa, fair_share, removed_victims)
     if consolidate:
         num_units = jnp.minimum(num_units,
                                 config.max_consolidation_preemptees)
@@ -329,7 +345,8 @@ def solve_for_preemptor(
         m_req, jnp.minimum(unit_rank, r.m), num_segments=r.m + 1)[:r.m]
     cum_freed = jnp.cumsum(unit_freed, axis=0)                 # [M, R]
     cluster_free = jnp.sum(
-        jnp.where(n.valid[:, None], free + n.releasing, 0.0), axis=0)
+        jnp.where(n.valid[:, None], free + n.releasing + extra, 0.0),
+        axis=0)
     enough = jnp.all(cluster_free[None, :] + cum_freed + EPS
                      >= total_req[None, :], axis=-1)           # [M]
     gate_prefilter = jnp.any(enough)  # no scenario can ever fit => skip all
@@ -372,38 +389,49 @@ def solve_for_preemptor(
         def run(_):
             mask_k = cand & (unit_rank <= k)
             freed_nodes, freed_dev, freed_queues = freed_tensors(mask_k)
+            # victim capacity is *releasing* until the pods terminate:
+            # the preemptor's tasks that land on it pipeline, tasks that
+            # fit genuinely idle capacity bind now (stmt.Allocate vs
+            # stmt.Pipeline).
+            extra_eff = extra + freed_nodes
+            extra_dev_eff = extra_dev + freed_dev
             # consolidation victims are moved, not removed — their queue
             # allocation stays (allPodsReallocated validator below)
             qa_eff = qa if consolidate else qa - freed_queues
             free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
-                _attempt_gang(state, gang_idx, free + freed_nodes,
-                              dev + freed_dev, qa_eff, qan, num_levels,
-                              alloc_cfg)
+                _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
+                              num_levels, alloc_cfg, extra_eff,
+                              extra_dev_eff)
             if consolidate:
                 free3, dev3, moves, all_ok = _replace_victims(
-                    state, mask_k, free2, dev2)
+                    state, mask_k, free2, dev2, n.releasing + extra_eff,
+                    state.nodes.device_releasing + extra_dev_eff)
                 return (free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t,
-                        moves, success & all_ok)
+                        moves, extra_eff, extra_dev_eff, success & all_ok)
             return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t,
-                    no_moves, success)
+                    no_moves, extra_eff, extra_dev_eff, success)
 
         def skip(_):
             return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
                     jnp.full((T,), -1, jnp.int32),
-                    jnp.zeros((T,), bool), no_moves, jnp.asarray(False))
+                    jnp.zeros((T,), bool), no_moves, extra, extra_dev,
+                    jnp.asarray(False))
 
-        free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, success = \
+        (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, extra2,
+         extra_dev2, success) = \
             lax.cond(prefix_ok & enough[jnp.minimum(k, r.m - 1)],
                      run, skip, None)
         best = jax.tree.map(
             lambda new, old: jnp.where(success, new, old),
-            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, k),
+            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
+             extra2, extra_dev2, k),
             best)
         return k + 1, success, prefix_ok, best
 
     empty = (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
              jnp.full((T,), -1, jnp.int32),
-             jnp.zeros((T,), bool), no_moves, jnp.asarray(0, jnp.int32))
+             jnp.zeros((T,), bool), no_moves, extra, extra_dev,
+             jnp.asarray(0, jnp.int32))
 
     def search(_):
         _, done, _, best = lax.while_loop(
@@ -416,21 +444,24 @@ def solve_for_preemptor(
         return jnp.asarray(False), empty
 
     success, (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-              k_win) = lax.cond(gate & gate_prefilter, search,
-                                no_search, None)
+              extra2, extra_dev2, k_win) = lax.cond(
+                  gate & gate_prefilter, search, no_search, None)
 
     victim_mask = cand & (unit_rank <= k_win) & success
     return (success, victim_mask, nodes_t, dev_t, pipe_t, moves,
-            free2, dev2, qa2, qan2)
+            free2, dev2, extra2, extra_dev2, qa2, qan2)
 
 
 def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
-                     device_free: jax.Array):
+                     device_free: jax.Array, releasing: jax.Array,
+                     device_releasing: jax.Array):
     """Greedy re-placement of evicted consolidation victims — the
     ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
     scenario is valid only if *every* victim fits somewhere on the
     post-preemptor state.  Resource-only feasibility (running pods carry
-    no selector in the snapshot); binpack by least free accel.
+    no selector in the snapshot); binpack by least free accel.  Moves may
+    draw on releasing capacity (including other victims' freed spots) —
+    they are always pipelined rebinds, waiting for the old pods to vacate.
 
     Returns (free' [N, R], device_free' [N, D], moves [M] i32 node per
     victim, all_ok [])."""
@@ -450,13 +481,15 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
             r.accel_mem[m] > 0,
             r.accel_mem[m] / jnp.maximum(n.device_memory_gib, EPS),
             r.accel_held[m])                                   # [N]
-        fit = jnp.all(free_l + EPS >= req[None, :], axis=-1) & n.valid
-        frac_fit = jnp.max(dev_l, axis=-1) >= p_n - EPS
-        whole_free = jnp.sum((dev_l >= 1.0 - EPS).astype(free_l.dtype),
+        avail = free_l + releasing
+        dev_avail = dev_l + device_releasing
+        fit = jnp.all(avail + EPS >= req[None, :], axis=-1) & n.valid
+        frac_fit = jnp.max(dev_avail, axis=-1) >= p_n - EPS
+        whole_free = jnp.sum((dev_avail >= 1.0 - EPS).astype(free_l.dtype),
                              axis=-1)
         whole_fit = whole_free + EPS >= req[0]
         fit = fit & jnp.where(is_frac, frac_fit, whole_fit)
-        score = jnp.where(fit, -free_l[:, 0], -jnp.inf)
+        score = jnp.where(fit, -avail[:, 0], -jnp.inf)
         node = jnp.argmax(score)
         placed = needed & jnp.any(fit)
         p = p_n[node]
@@ -466,7 +499,7 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
         free_l = free_l.at[node].add(-delta)
         # device debit: fraction joins its best-fitting device; whole
         # takes the first fully-free devices
-        dev_row = dev_l[node]
+        dev_row = dev_avail[node]
         frac_dev = jnp.argmax(dev_row)
         k = jnp.round(req[0]).astype(jnp.int32)
         fully = dev_row >= 1.0 - EPS
@@ -529,14 +562,19 @@ def run_victim_action(
                     jnp.full((T,), -1, jnp.int32),
                     jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
                     jnp.full((state.running.m,), -1, jnp.int32),
-                    res.free, res.device_free, res.queue_allocated,
+                    res.free, res.device_free, res.releasing_extra,
+                    res.device_releasing_extra, res.queue_allocated,
                     res.queue_allocated_nonpreemptible)
 
         (success, victims, nodes_t, dev_t, pipe_t, moves,
-         free2, dev2, qa2, qan2) = lax.cond(runnable, attempt, skip, None)
+         free2, dev2, extra2, extra_dev2, qa2, qan2) = lax.cond(
+             runnable, attempt, skip, None)
         res = res.replace(
             free=jnp.where(success, free2, res.free),
             device_free=jnp.where(success, dev2, res.device_free),
+            releasing_extra=jnp.where(success, extra2, res.releasing_extra),
+            device_releasing_extra=jnp.where(
+                success, extra_dev2, res.device_releasing_extra),
             queue_allocated=jnp.where(success, qa2, res.queue_allocated),
             queue_allocated_nonpreemptible=jnp.where(
                 success, qan2, res.queue_allocated_nonpreemptible),
@@ -544,9 +582,10 @@ def run_victim_action(
                 jnp.where(success, nodes_t, res.placements[gi])),
             placement_device=res.placement_device.at[gi].set(
                 jnp.where(success, dev_t, res.placement_device[gi])),
-            # preemptors pipeline onto their victims' releasing resources
+            # tasks on victim/releasing capacity pipeline; tasks that fit
+            # genuinely idle capacity bind now (stmt.Allocate vs Pipeline)
             pipelined=res.pipelined.at[gi].set(
-                jnp.where(success, nodes_t >= 0, res.pipelined[gi])),
+                jnp.where(success, pipe_t, res.pipelined[gi])),
             allocated=res.allocated.at[gi].set(res.allocated[gi] | success),
             attempted=res.attempted.at[gi].set(res.attempted[gi] | runnable),
             victim=res.victim | victims,

@@ -30,6 +30,10 @@ class Cluster:
     bind_requests: dict[str, apis.BindRequest] = dataclasses.field(default_factory=dict)
     #: monotonic clock advanced by the simulation driver
     now: float = 0.0
+    #: evicted pods whose workload controller will recreate them (the
+    #: consolidation-move path) — on the next tick they return to PENDING
+    #: instead of vanishing
+    restarting: set[str] = dataclasses.field(default_factory=set)
 
     # -- intake -----------------------------------------------------------
 
@@ -72,6 +76,16 @@ class Cluster:
                     and br.phase == "Pending"):
                 pods.append(dataclasses.replace(
                     p, status=apis.PodStatus.BOUND, node=br.selected_node))
+            elif (p.status == apis.PodStatus.RELEASING and br is not None
+                    and br.phase == "Pending"):
+                # consolidation move in flight: the pod still occupies its
+                # old node (releasing) AND holds a verified claim on the
+                # rebind target — present both, so a cycle run before the
+                # restart tick cannot steal the earmarked capacity.
+                pods.append(p)
+                pods.append(dataclasses.replace(
+                    p, status=apis.PodStatus.BOUND, node=br.selected_node,
+                    accel_devices=[]))
             else:
                 pods.append(p)
         return (
@@ -157,19 +171,33 @@ class Cluster:
         if group is not None and group.last_start_timestamp is None:
             group.last_start_timestamp = self.now
 
-    def evict_pod(self, pod_name: str) -> None:
+    def evict_pod(self, pod_name: str, restart: bool = False) -> None:
         """Eviction = delete pod; its resources become releasing until the
-        next tick reaps it (matching the reference's deletion grace window)."""
+        next tick reaps it (matching the reference's deletion grace window).
+
+        ``restart=True`` models the workload controller recreating the pod
+        (consolidation moves): after release it returns to PENDING so a
+        pipelined rebind can land it on its planned node.
+        """
         pod = self.pods.get(pod_name)
         if pod is not None:
             pod.status = apis.PodStatus.RELEASING
+            if restart:
+                self.restarting.add(pod_name)
 
     def tick(self, seconds: float = 1.0) -> None:
-        """Advance time: bound pods start running, releasing pods vanish."""
+        """Advance time: bound pods start running, releasing pods vanish
+        (or restart as pending, if their controller recreates them)."""
         self.now += seconds
         for name in list(self.pods):
             pod = self.pods[name]
             if pod.status == apis.PodStatus.RELEASING:
-                del self.pods[name]
+                if name in self.restarting:
+                    self.restarting.discard(name)
+                    pod.status = apis.PodStatus.PENDING
+                    pod.node = None
+                    pod.accel_devices = []
+                else:
+                    del self.pods[name]
             elif pod.status == apis.PodStatus.BOUND:
                 pod.status = apis.PodStatus.RUNNING

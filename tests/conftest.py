@@ -12,12 +12,12 @@ the environment before the CPU backend first initialises.
 """
 import os
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+from __graft_entry__ import _ensure_cpu_device_count  # noqa: E402
+
+_ensure_cpu_device_count(8)
 
 import jax  # noqa: E402
 

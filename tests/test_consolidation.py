@@ -111,3 +111,76 @@ class TestConsolidation:
                                       now=100.0)
         res = run_consolidate(state)
         assert not bool(res.allocated[index.gang_names.index("big")])
+
+
+class TestConsolidationMoveCommit:
+    """The commit path must *move* victims, not lose them — VERDICT r1 #3,
+    ref ``consolidation.go`` allPodsReallocated + Statement pipelining."""
+
+    def _cluster(self):
+        nodes = [apis.Node(f"node-{i}", Vec(4.0, 64.0, 256.0))
+                 for i in range(2)]
+        queues = [apis.Queue("q0", accel=QR(quota=8.0))]
+        frag0 = apis.PodGroup("frag0", queue="q0", min_member=1,
+                              last_start_timestamp=0.0)
+        frag1 = apis.PodGroup("frag1", queue="q0", min_member=1,
+                              creation_timestamp=0.5,
+                              last_start_timestamp=0.5)
+        pending = apis.PodGroup("big", queue="q0", min_member=1,
+                                creation_timestamp=1.0)
+        pods = [
+            apis.Pod("f0", "frag0", resources=Vec(2.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-0",
+                     accel_devices=[0, 1]),
+            apis.Pod("f1", "frag1", resources=Vec(2.0, 1.0, 4.0),
+                     status=apis.PodStatus.RUNNING, node="node-1",
+                     accel_devices=[0, 1]),
+            apis.Pod("big-0", "big", resources=Vec(4.0, 1.0, 4.0),
+                     creation_timestamp=1.0),
+        ]
+        from kai_scheduler_tpu.runtime import Cluster
+        c = Cluster.from_objects(nodes, queues, [frag0, frag1, pending],
+                                 pods)
+        c.now = 100.0
+        return c
+
+    def test_victim_is_rebound_on_planned_node_and_preemptor_placed(self):
+        from kai_scheduler_tpu.binder import Binder
+        from kai_scheduler_tpu.framework import Scheduler
+
+        cluster = self._cluster()
+        sched, binder = Scheduler(), Binder()
+        result = sched.run_once(cluster)
+
+        # one victim evicted WITH a move target + a pipelined rebind
+        assert len(result.evictions) == 1
+        ev = result.evictions[0]
+        assert ev.move_to is not None
+        assert len(result.move_bind_requests) == 1
+        assert result.move_bind_requests[0].pod_name == ev.pod_name
+        victim_name, planned_node = ev.pod_name, ev.move_to
+
+        # drive the world: release -> restart pending -> binder sweeps
+        for _ in range(4):
+            binder.reconcile(cluster)
+            cluster.tick()
+        binder.reconcile(cluster)
+        cluster.tick()
+
+        moved = cluster.pods[victim_name]
+        assert moved.status == apis.PodStatus.RUNNING
+        assert moved.node == planned_node
+
+        # the preemptor won its space (bound this or a later cycle)
+        sched.run_once(cluster)
+        binder.reconcile(cluster)
+        cluster.tick()
+        big_pod = cluster.pods["big-0"]
+        assert big_pod.status in (apis.PodStatus.BOUND,
+                                  apis.PodStatus.RUNNING)
+        # and it sits alone on its node (4 accel of 4)
+        others = [p for p in cluster.pods.values()
+                  if p.node == big_pod.node and p.name != big_pod.name
+                  and p.status in (apis.PodStatus.BOUND,
+                                   apis.PodStatus.RUNNING)]
+        assert others == []

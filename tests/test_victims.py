@@ -256,3 +256,57 @@ class TestCycleWithVictims:
         assert {br.pod_name for br in r2.bind_requests} == {"p0", "p1"}
         binder.reconcile(cluster)
         assert cluster.pods["p0"].status == apis.PodStatus.BOUND
+
+
+class TestEvictionUnitAccounting:
+    """ADVICE r1 (medium): surplus must be sized from the *effective*
+    active count — running pods minus victims already taken this cycle —
+    so successive actions cannot shrink a gang below minMember without
+    evicting the whole remainder as one unit (ref Statement.Evict
+    updating the counts GetTasksToEvict reads)."""
+
+    def _state(self):
+        nodes = [apis.Node("node-0", Vec(16.0, 64.0, 256.0))]
+        queues = [apis.Queue("q0", accel=QR(quota=16.0))]
+        gang = apis.PodGroup("elastic", queue="q0", min_member=8,
+                             last_start_timestamp=0.0)
+        pods = [apis.Pod(f"p{i}", "elastic", resources=Vec(1.0, 1.0, 1.0),
+                         status=apis.PodStatus.RUNNING, node="node-0",
+                         creation_timestamp=float(i))
+                for i in range(10)]
+        # a pending gang so G > 1 (not used by the unit ranking directly)
+        pending = apis.PodGroup("pend", queue="q0", min_member=1,
+                                creation_timestamp=20.0)
+        pods.append(apis.Pod("pend-0", "pend", resources=Vec(1.0, 1.0, 1.0),
+                             creation_timestamp=20.0))
+        return build_snapshot(nodes, queues, [gang, pending], pods,
+                              now=100.0)
+
+    def test_surplus_shrinks_with_accumulated_victims(self):
+        from kai_scheduler_tpu.ops.victims import _rank_eviction_units
+
+        state, index = self._state()
+        M = state.running.m
+        fair_share = drf.set_fair_share(state, num_levels=1)
+        gang_row = np.asarray(state.running.gang)
+        gi = index.gang_names.index("elastic")
+        cand_np = (np.asarray(state.running.valid)
+                   & (gang_row == gi))
+
+        # fresh cycle: 10 running, minMember 8 -> 2 single-pod units + 1
+        # whole-gang unit
+        no_victims = jnp.zeros((M,), bool)
+        _, num_units = _rank_eviction_units(
+            state, jnp.asarray(cand_np), state.queues.allocated,
+            fair_share, no_victims)
+        assert int(num_units) == 3
+
+        # 2 pods already victimised this cycle: gang sits AT minMember —
+        # the only remaining unit is the whole remaining gang
+        prior = np.zeros((M,), bool)
+        prior[np.nonzero(cand_np)[0][:2]] = True
+        cand2 = jnp.asarray(cand_np & ~prior)
+        _, num_units2 = _rank_eviction_units(
+            state, cand2, state.queues.allocated, fair_share,
+            jnp.asarray(prior))
+        assert int(num_units2) == 1
