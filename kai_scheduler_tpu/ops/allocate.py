@@ -46,7 +46,7 @@ from jax import lax
 from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
-from .predicates import feasible_nodes, node_portion
+from .predicates import feasible_nodes, feasible_nodes_dual, node_portion
 from .scoring import (W_TOPOLOGY, PlacementConfig, gpu_sharing_score,
                       pick_device, score_nodes_for_task)
 
@@ -113,6 +113,25 @@ def init_result(state: ClusterState) -> AllocationResult:
     )
 
 
+def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
+    """bool [Q, Q]: ``C[q, a]`` — queue ``a`` is ``q`` or an ancestor of
+    ``q``.  Computed once per action; turns per-task ancestor walks into
+    single masked reductions."""
+    Q = parent.shape[0]
+    eye = jnp.eye(Q, dtype=bool)
+
+    def hop(_, carry):
+        member, cur = carry
+        valid = cur >= 0
+        idx = jnp.maximum(cur, 0)
+        member = member | (valid[:, None] & eye[idx])
+        return member, jnp.where(valid, parent[idx], -1)
+
+    member, _ = lax.fori_loop(
+        0, num_levels, hop, (jnp.zeros((Q, Q), bool), jnp.arange(Q)))
+    return member
+
+
 def _ancestor_scatter(parent: jax.Array, q: jax.Array, num_levels: int,
                       arr: jax.Array, delta: jax.Array) -> jax.Array:
     """Add ``delta`` [R] to ``arr`` [Q, R] at queue ``q`` and its ancestors."""
@@ -153,9 +172,33 @@ class AllocateConfig:
     #: max gangs attempted per cycle — ref ``QueueDepthPerAction``;
     #: None = all valid gangs.
     queue_depth: int | None = None
-    #: re-sort the queue heap after every allocation (exact reference
-    #: semantics) vs freeze the order at cycle start (faster at large G).
+    #: re-sort the queue heap every wavefront chunk (the tensorized
+    #: equivalent of the reference's dynamic two-level heap, which
+    #: re-sorts after every single allocation) vs freeze the order at
+    #: cycle start.  With ``batch_size=1`` dynamic ordering is *exactly*
+    #: the reference's per-pop re-sort semantics.
     dynamic_order: bool = True
+    #: gangs attempted in parallel per wavefront chunk.  Each chunk
+    #: orders the remaining gangs by live fairness keys, attempts the
+    #: first ``batch_size`` independently against chunk-start state, and
+    #: accepts the maximal order-prefix whose *cumulative* claims fit
+    #: (nodes, devices, queue caps).  Conflict-rejected gangs retry next
+    #: chunk, so capacity semantics are exact; only the scoring heuristic
+    #: sees ≤1 chunk of staleness.  1 = fully sequential (reference-exact).
+    batch_size: int = 64
+    #: maintain the per-device share table.  Set False when the snapshot
+    #: holds no fractional/memory-based tasks — the node-level accel
+    #: vector is then exact and the device-granular bookkeeping (the
+    #: most op-heavy part of the task step) is skipped.  Session derives
+    #: this from the snapshot automatically.
+    track_devices: bool = True
+    #: every gang's pending tasks are identical (same request/selector,
+    #: no fractions) — the overwhelmingly common shape (a gang IS T
+    #: replicas).  Enables the vectorized whole-gang placement that fills
+    #: nodes by score order with per-node copy counts instead of T
+    #: sequential task steps.  Requires ``track_devices=False``.  Session
+    #: derives this from the snapshot automatically.
+    uniform_tasks: bool = False
 
 
 def _attempt_gang_in_domain(
@@ -167,7 +210,9 @@ def _attempt_gang_in_domain(
         pref_doms: jax.Array,          # i32 [N]  preferred-level domain ids
         has_pref: jax.Array,           # bool []
         extra_releasing: jax.Array,        # f32 [N, R] victim-freed capacity
-        extra_device_releasing: jax.Array  # f32 [N, D]
+        extra_device_releasing: jax.Array, # f32 [N, D]
+        lane: jax.Array,               # i32 [] wavefront lane (tie-break)
+        chain: jax.Array               # bool [Q, Q] ancestor membership
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -177,11 +222,23 @@ def _attempt_gang_in_domain(
     pipeline-fit check, so tasks landing on victim-freed capacity are
     marked pipelined (bind later) while tasks on genuinely idle capacity
     bind immediately — matching ``stmt.Allocate`` vs ``stmt.Pipeline``.
+
+    ``lane`` seeds a sub-score-resolution cyclic tie-break over nodes so
+    the wavefront's parallel lanes spread over *equal-scoring* nodes
+    instead of all argmaxing the same one (which would serialize the
+    chunk accept-prefix to one gang).  Real score differences dominate
+    the jitter; sequential (B=1) behavior has lane 0 ≡ plain first-index
+    tie-break on an idle cluster.
+
+    The task loop is unrolled (T is static): each step is small [N]-wide
+    work and an on-device loop would cost more in iteration overhead
+    than the unrolled graph.
     """
     g = state.gangs
     n = state.nodes
     T = g.t
     D = n.d
+    N = n.n
     task_req = g.task_req[gang_idx]          # [T, R]
     task_valid = g.task_valid[gang_idx]      # [T]
     task_sel = g.task_selector[gang_idx]     # [T, K]
@@ -190,68 +247,95 @@ def _attempt_gang_in_domain(
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
 
+    # cyclic per-lane rotation, scaled well below the 1.0-resolution of
+    # the score bands (density scores quantize coarsely on equal nodes)
+    tie_jitter = (-1e-4 / N) * jnp.mod(
+        jnp.arange(N) - lane, N).astype(jnp.float32)           # [N]
+
+    # Queue capacity gates (capacity_policy.go:26-50), hoisted out of the
+    # task loop: all tasks of a gang share one queue chain, so the gate
+    # for task t is "qa + cumulative request through t stays within every
+    # ancestor's cap".  Computed for all T prefixes in one reduction.
+    # (Slightly conservative vs the reference when a mid-gang task fails
+    # placement: its request still counts toward later tasks' prefix.)
+    anc = chain[queue]                                          # [Q]
+    limit_eff = jnp.where(state.queues.limit <= UNLIMITED + 0.5,
+                          jnp.inf, state.queues.limit)          # [Q, R]
+    quota_eff = jnp.where(state.queues.quota <= UNLIMITED + 0.5,
+                          jnp.inf, state.queues.quota)
+    req_valid = jnp.where(task_valid[:, None], task_req, 0.0)   # [T, R]
+    cum_req = jnp.cumsum(req_valid, axis=0)                     # [T, R]
+    exempt = ~anc[None, :, None]
+    gate_lim = jnp.all(
+        (q_alloc[None] + cum_req[:, None, :] <= limit_eff[None] + EPS)
+        | exempt, axis=(1, 2))                                  # [T]
+    gate_quota = jnp.all(
+        (q_alloc_np[None] + cum_req[:, None, :] <= quota_eff[None] + EPS)
+        | exempt, axis=(1, 2))
+    gate_t = gate_lim & jnp.where(nonpreempt, gate_quota, True)  # [T]
+
     def task_body(t, carry):
-        free_l, dev_l, qa, qan, nodes_t, dev_t, pipe_t, count, pref_dom = carry
+        free_l, dev_l, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom = carry
         req = task_req[t]
         is_frac = (task_portion[t] > 0) | (task_mem[t] > 0)
-        # queue capacity gates up the hierarchy (capacity_policy.go:26-50)
-        gate = _ancestor_gate(state.queues.parent, queue, num_levels,
-                              qa, state.queues.limit, req)
-        gate = gate & jnp.where(
-            nonpreempt,
-            _ancestor_gate(state.queues.parent, queue, num_levels,
-                           qan, state.queues.quota, req),
-            True)
-        ok = task_valid[t] & gate
+        ok = task_valid[t] & gate_t[t]
 
-        fit_idle = feasible_nodes(
+        fit_idle, fit_pipe = feasible_nodes_dual(
             n, req, task_sel[t], task_portion[t], task_mem[t],
-            free=free_l, device_free=dev_l) & domain_mask
-        fit_pipe = feasible_nodes(
-            n, req, task_sel[t], task_portion[t], task_mem[t],
-            free=free_l + extra_releasing,
-            device_free=dev_l + extra_device_releasing,
-            include_releasing=True) & domain_mask                      # [N]
+            free=free_l, device_free=dev_l,
+            extra_releasing=extra_releasing,
+            extra_device_releasing=extra_device_releasing,
+            devices=config.track_devices)
+        fit_idle = fit_idle & domain_mask
+        fit_pipe = fit_pipe & domain_mask                              # [N]
         # preferred-level locality band (topology plugin node scoring):
         # stick with the domain of the gang's first-placed task.
         topo_band = jnp.where(
             has_pref & (pref_dom >= 0) & (pref_doms == pref_dom),
             W_TOPOLOGY, 0.0)                                           # [N]
-        portion_n = node_portion(n, task_portion[t], task_mem[t])      # [N]
-        sharing_band = gpu_sharing_score(dev_l, portion_n, is_frac)    # [N]
+        extra_bands = topo_band + tie_jitter
+        if config.track_devices:
+            portion_n = node_portion(n, task_portion[t], task_mem[t])  # [N]
+            extra_bands = extra_bands + gpu_sharing_score(
+                dev_l, portion_n, is_frac)                             # [N]
         scores = score_nodes_for_task(
             n, free_l, req, fit_idle, fit_pipe, config.placement,
-            extra=topo_band + sharing_band)                            # [N]
+            extra=extra_bands)                                         # [N]
         node = jnp.argmax(scores)
         placed = ok & jnp.any(fit_pipe)
         is_pipe = placed & ~fit_idle[node]
 
-        # ---- device bookkeeping (GPU-group allocation) ------------------
-        dev_row = dev_l[node]                                          # [D]
-        dev_rel_row = (n.device_releasing[node]
-                       + extra_device_releasing[node])
-        p = portion_n[node]
-        # fractional: GpuOrderFn pick among idle-fitting devices; a
-        # pipelined fraction may dip into releasing share (bounded
-        # negative, like the node-level free carry)
-        frac_row = jnp.where(is_pipe, dev_row + dev_rel_row, dev_row)
-        frac_dev = pick_device(frac_row, p, pack=config.placement.device_pack)
-        # whole: take ceil(req) devices, idle-free first then releasing
-        k = jnp.round(req[0]).astype(jnp.int32)
-        eligible = dev_row + dev_rel_row >= 1.0 - EPS
-        rank_key = jnp.where(eligible, -dev_row, jnp.inf)
-        rank = jnp.sum(
-            (rank_key[None, :] < rank_key[:, None])
-            | ((rank_key[None, :] == rank_key[:, None])
-               & (jnp.arange(D)[None, :] < jnp.arange(D)[:, None])),
-            axis=-1)                                                   # [D]
-        take_whole = eligible & (rank < k)
-        dev_delta = jnp.where(
-            is_frac,
-            p * (jnp.arange(D) == frac_dev),
-            take_whole.astype(dev_row.dtype))
-        dev_delta = jnp.where(placed, dev_delta, 0.0)
-        dev_l = dev_l.at[node].add(-dev_delta)
+        if config.track_devices:
+            # ---- device bookkeeping (GPU-group allocation) --------------
+            dev_row = dev_l[node]                                      # [D]
+            dev_rel_row = (n.device_releasing[node]
+                           + extra_device_releasing[node])
+            p = portion_n[node]
+            # fractional: GpuOrderFn pick among idle-fitting devices; a
+            # pipelined fraction may dip into releasing share (bounded
+            # negative, like the node-level free carry)
+            frac_row = jnp.where(is_pipe, dev_row + dev_rel_row, dev_row)
+            frac_dev = pick_device(frac_row, p,
+                                   pack=config.placement.device_pack)
+            # whole: take ceil(req) devices, idle-free first then releasing
+            k = jnp.round(req[0]).astype(jnp.int32)
+            eligible = dev_row + dev_rel_row >= 1.0 - EPS
+            rank_key = jnp.where(eligible, -dev_row, jnp.inf)
+            rank = jnp.sum(
+                (rank_key[None, :] < rank_key[:, None])
+                | ((rank_key[None, :] == rank_key[:, None])
+                   & (jnp.arange(D)[None, :] < jnp.arange(D)[:, None])),
+                axis=-1)                                               # [D]
+            take_whole = eligible & (rank < k)
+            dev_delta = jnp.where(
+                is_frac,
+                p * (jnp.arange(D) == frac_dev),
+                take_whole.astype(dev_row.dtype))
+            dev_delta = jnp.where(placed, dev_delta, 0.0)
+            dev_l = dev_l.at[node].add(-dev_delta)
+        else:
+            p = req[0]
+            frac_dev = jnp.asarray(-1, jnp.int32)
 
         delta = jnp.where(placed, req, 0.0)
         # node-level accel debit uses the node's actual share (memory-
@@ -259,10 +343,7 @@ def _attempt_gang_in_domain(
         delta_node = delta.at[0].set(
             jnp.where(placed, jnp.where(is_frac, p, req[0]), 0.0))
         free_l = free_l.at[node].add(-delta_node)
-        qa = _ancestor_scatter(state.queues.parent, queue, num_levels, qa, delta)
-        qan = _ancestor_scatter(
-            state.queues.parent, queue, num_levels, qan,
-            jnp.where(nonpreempt, delta, 0.0))
+        q_delta = q_delta + delta
         nodes_t = nodes_t.at[t].set(jnp.where(placed, node, -1))
         dev_t = dev_t.at[t].set(
             jnp.where(placed & is_frac, frac_dev, -1))
@@ -270,18 +351,137 @@ def _attempt_gang_in_domain(
         count = count + placed.astype(jnp.int32)
         pref_dom = jnp.where(placed & (pref_dom < 0), pref_doms[node],
                              pref_dom)
-        return free_l, dev_l, qa, qan, nodes_t, dev_t, pipe_t, count, pref_dom
+        return free_l, dev_l, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom
 
-    init = (free, device_free, q_alloc, q_alloc_np,
-            jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
-            jnp.zeros((T,), bool),
-            jnp.asarray(0, jnp.int32), jnp.asarray(-1, jnp.int32))
-    free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, count, _ = lax.fori_loop(
-        0, T, task_body, init)
+    carry = (free, device_free,
+             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
+             jnp.zeros((T,), bool),
+             jnp.asarray(0, jnp.int32), jnp.zeros_like(task_req[0]),
+             jnp.asarray(-1, jnp.int32))
+    for t in range(T):  # static unroll — see docstring
+        carry = task_body(t, carry)
+    free2, dev2, nodes_t, dev_t, pipe_t, count, q_delta, _ = carry
+    # queue accounting applied once for the whole gang along its chain
+    qa2 = q_alloc + anc[:, None] * q_delta[None, :]
+    qan2 = q_alloc_np + jnp.where(nonpreempt,
+                                  anc[:, None] * q_delta[None, :], 0.0)
     # min_needed (not min_member): pods already bound/running count toward
     # the gang's quorum — elastic scale-up and pipelined-remainder gangs.
     success = count >= g.min_needed[gang_idx]
     return free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success
+
+
+def _attempt_gang_in_domain_uniform(
+        state: ClusterState, gang_idx: jax.Array,
+        free: jax.Array, device_free: jax.Array,
+        q_alloc: jax.Array, q_alloc_np: jax.Array,
+        num_levels: int, config: AllocateConfig,
+        domain_mask: jax.Array, pref_doms: jax.Array, has_pref: jax.Array,
+        extra_releasing: jax.Array, extra_device_releasing: jax.Array,
+        lane: jax.Array, chain: jax.Array):
+    """Whole-gang placement for uniform-task gangs, no per-task loop.
+
+    A gang whose T pending tasks are identical replicas (the dominant
+    real shape — and the one the reference's benchmarks use) admits a
+    closed-form greedy: per node, how many replicas fit (`copies`); fill
+    nodes in score order until the gang is whole.  Equivalent to the
+    sequential task loop under binpack scoring (a node's binpack score
+    only rises as it fills, so the sequential greedy would keep hitting
+    the same node until it is full, which is exactly the capacity-count
+    fill); spread scoring drifts from the loop by design.
+
+    Same signature/returns as :func:`_attempt_gang_in_domain`.
+    """
+    g, n = state.gangs, state.nodes
+    T, N = g.t, n.n
+    req = g.task_req[gang_idx, 0]                       # [R] the replica
+    sel = g.task_selector[gang_idx, 0]                  # [K]
+    task_valid = g.task_valid[gang_idx]                 # [T]
+    tcount = jnp.sum(task_valid.astype(jnp.int32))
+    queue = g.queue[gang_idx]
+    nonpreempt = ~g.preemptible[gang_idx]
+    anc = chain[queue]                                  # [Q]
+
+    tie_jitter = (-1e-4 / N) * jnp.mod(
+        jnp.arange(N) - lane, N).astype(jnp.float32)    # [N]
+
+    # ---- queue capacity gate: max replicas within every ancestor cap ----
+    limit_eff = jnp.where(state.queues.limit <= UNLIMITED + 0.5,
+                          jnp.inf, state.queues.limit)
+    quota_eff = jnp.where(state.queues.quota <= UNLIMITED + 0.5,
+                          jnp.inf, state.queues.quota)
+    req_pos = req > EPS
+
+    def max_copies(used, cap):
+        head = jnp.where(req_pos[None, :],
+                         (cap - used) / jnp.maximum(req, EPS)[None, :],
+                         jnp.inf)                       # [Q, R]
+        head = jnp.where(anc[:, None], head, jnp.inf)
+        m = jnp.min(jnp.floor(head + EPS))
+        return jnp.clip(m, 0.0, 1e9).astype(jnp.int32)
+
+    m_gate = max_copies(q_alloc, limit_eff)
+    m_gate = jnp.where(nonpreempt,
+                       jnp.minimum(m_gate, max_copies(q_alloc_np, quota_eff)),
+                       m_gate)
+
+    # ---- per-node replica capacity --------------------------------------
+    zero = jnp.zeros((), req.dtype)
+    fit_idle, fit_pipe = feasible_nodes_dual(
+        n, req, sel, zero, zero,
+        free=free, device_free=device_free,
+        extra_releasing=extra_releasing,
+        extra_device_releasing=extra_device_releasing, devices=False)
+    fit_idle = fit_idle & domain_mask
+    fit_pipe = fit_pipe & domain_mask
+
+    def copies(avail, mask):
+        c = jnp.where(req_pos[None, :],
+                      (avail + EPS) / jnp.maximum(req, EPS)[None, :],
+                      jnp.inf)                          # [N, R]
+        c = jnp.floor(jnp.min(c, axis=-1))
+        return jnp.where(mask, jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+
+    c_pipe = copies(free + n.releasing + extra_releasing, fit_pipe)  # [N]
+    c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
+
+    # ---- scores (one pass; locality band anchored at the best node) -----
+    scores0 = score_nodes_for_task(
+        n, free, req, fit_idle, fit_pipe, config.placement,
+        extra=tie_jitter)                               # [N]
+    best = jnp.argmax(scores0)
+    topo_band = jnp.where(
+        has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
+    scores = jnp.where(fit_pipe, scores0 + topo_band, scores0)
+
+    # ---- greedy fill by score order -------------------------------------
+    order = jnp.argsort(-scores)                        # [N]
+    feas_sorted = fit_pipe[order]
+    c_sorted = jnp.where(feas_sorted, c_pipe[order], 0)
+    want = jnp.minimum(tcount, m_gate)
+    cum = jnp.cumsum(c_sorted)                          # [N]
+    placed_sorted = jnp.clip(want - (cum - c_sorted), 0, c_sorted)
+    total_placed = jnp.minimum(
+        cum[-1] if N > 0 else jnp.asarray(0), want)
+
+    tpos = jnp.arange(T, dtype=jnp.int32)
+    sidx = jnp.searchsorted(cum, tpos, side="right")    # [T]
+    sidx = jnp.minimum(sidx, N - 1)
+    placed_t = task_valid & (tpos < total_placed)
+    nodes_t = jnp.where(placed_t, order[sidx], -1)
+    # within a node the first c_idle replicas bind now, the rest pipeline
+    rank_in_node = tpos - (cum[sidx] - c_sorted[sidx])
+    pipe_t = placed_t & (rank_in_node >= c_idle[order[sidx]])
+
+    placed_per_node = jnp.zeros((N,), jnp.int32).at[order].set(placed_sorted)
+    free2 = free - placed_per_node[:, None].astype(free.dtype) * req[None, :]
+    q_delta = total_placed.astype(free.dtype) * req
+    qa2 = q_alloc + anc[:, None] * q_delta[None, :]
+    qan2 = q_alloc_np + jnp.where(nonpreempt,
+                                  anc[:, None] * q_delta[None, :], 0.0)
+    success = total_placed >= g.min_needed[gang_idx]
+    dev_t = jnp.full((T,), -1, jnp.int32)
+    return free2, device_free, qa2, qan2, nodes_t, dev_t, pipe_t, success
 
 
 def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
@@ -289,7 +489,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   q_alloc: jax.Array, q_alloc_np: jax.Array,
                   num_levels: int, config: AllocateConfig,
                   extra_releasing: jax.Array | None = None,
-                  extra_device_releasing: jax.Array | None = None):
+                  extra_device_releasing: jax.Array | None = None,
+                  lane: jax.Array | None = None,
+                  chain: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -309,6 +511,10 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         extra_releasing = jnp.zeros_like(free)
     if extra_device_releasing is None:
         extra_device_releasing = jnp.zeros_like(device_free)
+    if lane is None:
+        lane = jnp.asarray(0, jnp.int32)
+    if chain is None:
+        chain = _chain_membership(state.queues.parent, num_levels)
 
     pl = g.preferred_level[gang_idx]
     has_pref = pl >= 0
@@ -317,11 +523,18 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     rl = g.required_level[gang_idx]
     has_req = rl >= 0
 
+    if config.uniform_tasks:
+        assert not config.track_devices, \
+            "uniform_tasks fast path requires track_devices=False"
+        in_domain = _attempt_gang_in_domain_uniform
+    else:
+        in_domain = _attempt_gang_in_domain
+
     def unconstrained(_):
-        return _attempt_gang_in_domain(
+        return in_domain(
             state, gang_idx, free, device_free, q_alloc, q_alloc_np,
             num_levels, config, n.valid, pref_doms, has_pref,
-            extra_releasing, extra_device_releasing)
+            extra_releasing, extra_device_releasing, lane, chain)
 
     def constrained(_):
         doms = n.topology[:, jnp.maximum(rl, 0)]               # [N]
@@ -349,16 +562,20 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
 
         def cond(carry):
             tried, done, _ = carry
-            return ~done & jnp.any(fits & ~tried)
+            # has_req in the condition matters under vmap: lax.cond
+            # becomes a select and this "dead" branch still runs for
+            # unconstrained lanes — without the guard it would iterate
+            # the domain loop for every lane of every chunk
+            return has_req & ~done & jnp.any(fits & ~tried)
 
         def body(carry):
             tried, _, best = carry
             cand = fits & ~tried
             d = jnp.argmin(jnp.where(cand, dom_key, jnp.inf))
-            out = _attempt_gang_in_domain(
+            out = in_domain(
                 state, gang_idx, free, device_free, q_alloc, q_alloc_np,
                 num_levels, config, doms == d, pref_doms, has_pref,
-                extra_releasing, extra_device_releasing)
+                extra_releasing, extra_device_releasing, lane, chain)
             success = out[-1]
             best = jax.tree.map(
                 lambda nw, old: jnp.where(success, nw, old), out, best)
@@ -389,68 +606,130 @@ def allocate(
     g, n, q = state.gangs, state.nodes, state.queues
     G, T = g.g, g.t
     total = state.total_capacity
-    steps = G if config.queue_depth is None else min(G, config.queue_depth)
+    B = max(1, min(config.batch_size, G))
     if init is None:
         init = init_result(state)
 
-    # Releasing capacity participates in the pool (pipeline placements);
-    # the free carry is the *idle* pool and may dip negative by at most
-    # each node's releasing amount — feasibility always checks the sum.
-    static_order = None
-    if not config.dynamic_order:
-        static_order = ordering.static_job_order(
-            g, q, init.queue_allocated, fair_share, total)
+    extra, extra_dev = init.releasing_extra, init.device_releasing_extra
+    rel_floor = -(n.releasing + extra) - EPS          # [N, R] free lower bound
+    dev_floor = -(n.device_releasing + extra_dev) - EPS
+    limit_eff = jnp.where(q.limit <= UNLIMITED + 0.5, jnp.inf, q.limit)
+    quota_eff = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
 
-    def step(carry, step_idx):
-        res, remaining = carry
+    remaining0 = g.valid & (g.backoff <= 0) & ~init.allocated
+    static_rank = None
+    if not config.dynamic_order or config.queue_depth is not None:
+        order0 = ordering.job_order_perm(
+            g, q, init.queue_allocated, fair_share, total, remaining0)
+        static_rank = jnp.zeros((G,), jnp.float32).at[order0].set(
+            jnp.arange(G, dtype=jnp.float32))
+    if config.queue_depth is not None:
+        # global attempt budget — ref QueueDepthPerAction
+        remaining0 = remaining0 & (static_rank < config.queue_depth)
+
+    chain = _chain_membership(q.parent, num_levels)
+
+    def attempt_one(gi, lane, free, dev, qa, qan):
+        return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
+                             config, extra, extra_dev, lane, chain)
+
+    def cond(carry):
+        res, remaining, fuel = carry
+        return jnp.any(remaining) & (fuel > 0)
+
+    def chunk(carry):
+        res, remaining, fuel = carry
         free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
                               res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
-            gi = ordering.select_next_gang(g, q, qa, fair_share, total, remaining)
+            order = ordering.job_order_perm(
+                g, q, qa, fair_share, total, remaining)
         else:
-            gi = static_order[step_idx]
-        runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0)
+            # frozen keys, retired gangs pushed last (last lexsort key is
+            # most significant)
+            order = jnp.lexsort(
+                (static_rank, (~remaining).astype(jnp.float32)))
+        cand = order[:B]                                          # [B]
+        cand_valid = remaining[cand]
 
-        def attempt(args):
-            free, dev, qa, qan = args
-            free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
-                _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
-                              config, init.releasing_extra,
-                              init.device_releasing_extra)
-            # checkpoint/rollback: keep post-gang state only on success
-            sel = lambda a, b: jnp.where(success, a, b)
-            return (sel(free2, free), sel(dev2, dev), sel(qa2, qa),
-                    sel(qan2, qan),
-                    jnp.where(success, nodes_t, -jnp.ones_like(nodes_t)),
-                    jnp.where(success, dev_t, -jnp.ones_like(dev_t)),
-                    jnp.where(success, pipe_t, jnp.zeros_like(pipe_t)),
-                    success)
+        # independent attempts against chunk-start state (the vmapped
+        # replacement for the reference's one-job-at-a-time hot loop)
+        # lanes start their cyclic tie-break stride-apart across the node
+        # axis so a chunk of identical gangs fans out over equal-scoring
+        # nodes instead of colliding on one
+        lanes = jnp.arange(B, dtype=jnp.int32) * max(1, n.n // B)
+        free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b = \
+            jax.vmap(attempt_one, in_axes=(0, 0, None, None, None, None))(
+                cand, lanes, free, dev, qa, qan)
+        succ_b = succ_b & cand_valid
 
-        def skip(args):
-            free, dev, qa, qan = args
-            return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
-                    jnp.full((T,), -1, jnp.int32),
-                    jnp.zeros((T,), bool), jnp.asarray(False))
+        ok = succ_b[:, None, None]
+        d_free = jnp.where(ok, free - free2_b, 0.0)               # [B, N, R]
+        d_qa = jnp.where(ok, qa2_b - qa, 0.0)                     # [B, Q, R]
+        d_qan = jnp.where(ok, qan2_b - qan, 0.0)
 
-        free, dev, qa, qan, nodes_t, dev_t, pipe_t, success = lax.cond(
-            runnable, attempt, skip, (free, dev, qa, qan))
+        # maximal order-prefix whose cumulative claims still fit.  Deltas
+        # are non-negative, so the per-prefix feasibility flags are
+        # monotone and the accept mask IS the prefix mask.
+        cum_free = jnp.cumsum(d_free, axis=0)
+        cum_qa = jnp.cumsum(d_qa, axis=0)
+        cum_qan = jnp.cumsum(d_qan, axis=0)
+        ok_node = jnp.all(free[None] - cum_free >= rel_floor[None],
+                          axis=(1, 2))                            # [B]
+        # capacity gates re-checked jointly; queues untouched by the
+        # chunk (zero delta) are exempt — they may legitimately sit over
+        # limit from pre-existing allocation
+        ok_qa = jnp.all((qa[None] + cum_qa <= limit_eff[None] + EPS)
+                        | (cum_qa <= EPS), axis=(1, 2))
+        ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff[None] + EPS)
+                         | (cum_qan <= EPS), axis=(1, 2))
+        accept = ok_node & ok_qa & ok_qan                         # [B]
+        if config.track_devices:
+            d_dev = jnp.where(ok, dev - dev2_b, 0.0)              # [B, N, D]
+            cum_dev = jnp.cumsum(d_dev, axis=0)
+            accept = accept & jnp.all(
+                dev[None] - cum_dev >= dev_floor[None], axis=(1, 2))
+
+        take = succ_b & accept
+        w = take.astype(free.dtype)
+        free = free - jnp.einsum("b,bnr->nr", w, d_free)
+        qa = qa + jnp.einsum("b,bqr->qr", w, d_qa)
+        qan = qan + jnp.einsum("b,bqr->qr", w, d_qan)
+        if config.track_devices:
+            dev = dev - jnp.einsum("b,bnd->nd", w, d_dev)
+
+        nodes_b = jnp.where(take[:, None], nodes_b, -1)
+        devt_b = jnp.where(take[:, None], devt_b, -1)
+        pipe_b = jnp.where(take[:, None], pipe_b, False)
+        # done: placed (take) or individually infeasible (failure is
+        # final — capacity only shrinks).  Conflict-rejected successes
+        # retry next chunk.
+        done_b = cand_valid & (take | ~succ_b)
         res = res.replace(
             free=free, device_free=dev, queue_allocated=qa,
             queue_allocated_nonpreemptible=qan,
-            placements=res.placements.at[gi].set(
-                jnp.where(runnable, nodes_t, res.placements[gi])),
-            placement_device=res.placement_device.at[gi].set(
-                jnp.where(runnable, dev_t, res.placement_device[gi])),
-            pipelined=res.pipelined.at[gi].set(
-                jnp.where(runnable, pipe_t, res.pipelined[gi])),
-            allocated=res.allocated.at[gi].set(res.allocated[gi] | success),
-            attempted=res.attempted.at[gi].set(res.attempted[gi] | runnable),
+            placements=res.placements.at[cand].set(
+                jnp.where(cand_valid[:, None], nodes_b,
+                          res.placements[cand])),
+            placement_device=res.placement_device.at[cand].set(
+                jnp.where(cand_valid[:, None], devt_b,
+                          res.placement_device[cand])),
+            pipelined=res.pipelined.at[cand].set(
+                jnp.where(cand_valid[:, None], pipe_b,
+                          res.pipelined[cand])),
+            allocated=res.allocated.at[cand].set(
+                res.allocated[cand] | take),
+            attempted=res.attempted.at[cand].set(
+                res.attempted[cand] | cand_valid),
         )
-        remaining = remaining.at[gi].set(False)
-        return (res, remaining), None
+        remaining = remaining.at[cand].set(remaining[cand] & ~done_b)
+        return res, remaining, fuel - 1
 
-    remaining0 = g.valid & (g.backoff <= 0) & ~init.allocated
-    (res, _), _ = lax.scan(step, (init, remaining0), jnp.arange(steps))
+    # fuel: every chunk retires ≥1 remaining gang (the first remaining
+    # gang in order always lands in the accept prefix), so G chunks is a
+    # hard upper bound; the common case is ceil(G/B) + a few conflicts.
+    res, _, _ = lax.while_loop(cond, chunk, (init, remaining0,
+                                             jnp.asarray(G, jnp.int32)))
     return res
 
 

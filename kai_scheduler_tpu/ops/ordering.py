@@ -58,6 +58,36 @@ def queue_order_keys(
     )
 
 
+def job_order_perm(
+    gangs: GangState,
+    queues: QueueState,
+    queue_allocated: jax.Array,   # f32 [Q, R]
+    fair_share: jax.Array,        # f32 [Q, R]
+    total: jax.Array,             # f32 [R]
+    remaining: jax.Array,         # bool [G]  gangs not yet attempted
+) -> jax.Array:
+    """Full gang permutation [G] by the two-level heap order, remaining
+    gangs first — one heap rebuild against the *live* allocation tensors.
+    """
+    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
+        queues, queue_allocated, fair_share, total)
+    qi = gangs.queue
+    not_rem = (~remaining).astype(jnp.float32)
+    # elastic plugin: gangs whose *active* pods are below minMember first
+    below_min = gangs.running_count < gangs.min_member
+    # lexsort: LAST key is most significant.
+    return jnp.lexsort((
+        gangs.creation_order.astype(jnp.float32),
+        -gangs.priority.astype(jnp.float32),
+        (~below_min).astype(jnp.float32),   # elastic: below-min gangs first
+        dom_share[qi],
+        neg_prio[qi],
+        over_quota[qi],
+        over_fs[qi],
+        not_rem,                            # exhausted gangs last
+    ))
+
+
 def select_next_gang(
     gangs: GangState,
     queues: QueueState,
@@ -71,24 +101,8 @@ def select_next_gang(
 
     Equivalent to one ``PopNextJob`` from the two-level heap.
     """
-    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
-        queues, queue_allocated, fair_share, total)
-    qi = gangs.queue
-    not_rem = (~remaining).astype(jnp.float32)
-    # elastic plugin: gangs whose *active* pods are below minMember first
-    below_min = gangs.running_count < gangs.min_member
-    # lexsort: LAST key is most significant.
-    order = jnp.lexsort((
-        gangs.creation_order.astype(jnp.float32),
-        -gangs.priority.astype(jnp.float32),
-        (~below_min).astype(jnp.float32),   # elastic: below-min gangs first
-        gangs.creation_order.astype(jnp.float32) * 0 + dom_share[qi],
-        neg_prio[qi],
-        over_quota[qi],
-        over_fs[qi],
-        not_rem,                            # exhausted gangs last
-    ))
-    return order[0]
+    return job_order_perm(
+        gangs, queues, queue_allocated, fair_share, total, remaining)[0]
 
 
 def static_job_order(

@@ -149,6 +149,57 @@ def feasible_nodes(
     return fit & accel & sel & nodes.valid
 
 
+def feasible_nodes_dual(
+    nodes: NodeState,
+    task_req: jax.Array,        # f32 [R]
+    task_selector: jax.Array,   # i32 [K]
+    task_portion: jax.Array,    # f32 []
+    task_accel_mem: jax.Array,  # f32 []
+    *,
+    free: jax.Array,            # f32 [N, R]
+    device_free: jax.Array,     # f32 [N, D]
+    extra_releasing: jax.Array,        # f32 [N, R]
+    extra_device_releasing: jax.Array, # f32 [N, D]
+    devices: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """(fit_idle, fit_pipe) in one pass — the allocation kernel's hot
+    check, sharing the selector/validity work between the idle pool and
+    the idle+releasing (pipeline) pool instead of two full chains.
+
+    ``devices=False`` skips the device-granular table (valid when the
+    snapshot holds no fractional/memory-based tasks — the node-level
+    accel vector is then exact)."""
+    mem = jnp.asarray(task_accel_mem)
+    portion = jnp.asarray(task_portion)
+    is_frac = (portion > 0) | (mem > 0)
+    req = jnp.asarray(task_req)
+    sel = selector_mask(nodes.labels, task_selector) & nodes.valid     # [N]
+
+    if not devices:
+        fit_idle = jnp.all(free + EPS >= req[None, :], axis=-1) & sel
+        avail = free + nodes.releasing + extra_releasing
+        fit_pipe = jnp.all(avail + EPS >= req[None, :], axis=-1) & sel
+        return fit_idle, fit_pipe
+
+    req_nosum = req.at[RESOURCE_ACCEL].set(
+        jnp.where(is_frac, 0.0, req[RESOURCE_ACCEL]))
+    p = node_portion(nodes, portion, mem)                              # [N]
+    req_accel = req[RESOURCE_ACCEL]
+
+    def pools(avail, df):
+        fit = jnp.all(avail + EPS >= req_nosum[None, :], axis=-1)
+        frac_ok = jnp.max(df, axis=-1) >= p - EPS
+        whole = jnp.sum((df >= 1.0 - EPS).astype(jnp.float32), axis=-1)
+        accel = jnp.where(is_frac, frac_ok, whole + EPS >= req_accel)
+        return fit & accel
+
+    fit_idle = pools(free, device_free) & sel
+    fit_pipe = pools(
+        free + nodes.releasing + extra_releasing,
+        device_free + nodes.device_releasing + extra_device_releasing) & sel
+    return fit_idle, fit_pipe
+
+
 def gang_feasibility(
     nodes: NodeState,
     task_req: jax.Array,       # f32 [T, R]

@@ -63,7 +63,7 @@ from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
-                       _attempt_gang, init_result)
+                       _attempt_gang, _chain_membership, init_result)
 
 EPS = 1e-6
 BIG = jnp.int32(2**30)
@@ -122,23 +122,6 @@ def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
     freed_q = jnp.einsum("qa,qr->ar", chain_f, leaf)
     freed_q_np = jnp.einsum("qa,qr->ar", chain_f, leaf_np)
     return freed_nodes, freed_dev, freed_q, freed_q_np
-
-
-def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
-    """bool [Q, Q]: ``C[q, a]`` — queue ``a`` is ``q`` or an ancestor of ``q``."""
-    Q = parent.shape[0]
-    eye = jnp.eye(Q, dtype=bool)
-
-    def hop(_, carry):
-        member, cur = carry
-        valid = cur >= 0
-        idx = jnp.maximum(cur, 0)
-        member = member | (valid[:, None] & eye[idx])
-        return member, jnp.where(valid, parent[idx], -1)
-
-    member, _ = lax.fori_loop(
-        0, num_levels, hop, (jnp.zeros((Q, Q), bool), jnp.arange(Q)))
-    return member
 
 
 def victim_candidates(
@@ -401,7 +384,7 @@ def solve_for_preemptor(
             free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
                 _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
                               num_levels, alloc_cfg, extra_eff,
-                              extra_dev_eff)
+                              extra_dev_eff, chain=chain)
             if consolidate:
                 free3, dev3, moves, all_ok = _replace_victims(
                     state, mask_k, free2, dev2, n.releasing + extra_eff,
