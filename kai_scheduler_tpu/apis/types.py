@@ -142,6 +142,17 @@ class Pod:
     #: Assigned by the binder (ref SelectedGPUGroups + reservation pod).
     accel_devices: list[int] = dataclasses.field(default_factory=list)
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: pod labels — the match target of other pods' PodAffinityTerms
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: list["Toleration"] = dataclasses.field(default_factory=list)
+    #: required node-affinity matchExpressions, ANDed
+    node_affinity: list["AffinityExpr"] = dataclasses.field(
+        default_factory=list)
+    pod_affinity: list["PodAffinityTerm"] = dataclasses.field(
+        default_factory=list)
+    #: preempted pods carry the node their preemption cleared — the
+    #: nominatednode plugin gives it a dominating score bonus
+    nominated_node: str | None = None
     creation_timestamp: float = 0.0
 
 
@@ -150,6 +161,99 @@ class Preemptibility(str, enum.Enum):
 
     PREEMPTIBLE = "Preemptible"
     NON_PREEMPTIBLE = "NonPreemptible"
+
+
+# ---------------------------------------------------------------------------
+# Node-filter vocabulary: taints, tolerations, affinity
+# (ref k8s_internal/predicates/predicates.go:70-140 — the upstream
+# TaintToleration / NodeAffinity / InterPodAffinity filter surface)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    """A node taint — upstream corev1.Taint semantics."""
+
+    key: str
+    value: str = ""
+    #: "NoSchedule" | "PreferNoSchedule" | "NoExecute"
+    effect: str = "NoSchedule"
+
+
+@dataclasses.dataclass(frozen=True)
+class Toleration:
+    """A pod toleration — upstream corev1.Toleration semantics.
+
+    ``key=None`` with operator "Exists" tolerates every taint;
+    ``effect=None`` matches all effects.
+    """
+
+    key: str | None = None
+    operator: str = "Equal"    # "Equal" | "Exists"
+    value: str = ""
+    effect: str | None = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect is not None and self.effect != taint.effect:
+            return False
+        if self.key is None:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        return self.operator == "Exists" or self.value == taint.value
+
+
+@dataclasses.dataclass(frozen=True)
+class AffinityExpr:
+    """One node-affinity matchExpression (requiredDuringScheduling term).
+
+    Operators: In / NotIn / Exists / DoesNotExist / Gt / Lt — upstream
+    NodeSelectorRequirement semantics.  A pod's expressions are ANDed.
+    """
+
+    key: str
+    operator: str = "In"
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        present = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return present and val in self.values
+        if self.operator == "NotIn":
+            return not present or val not in self.values
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        if self.operator in ("Gt", "Lt"):
+            if not present or not self.values:
+                return False
+            try:
+                lhs, rhs = int(val), int(self.values[0])
+            except ValueError:
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        raise ValueError(f"unknown affinity operator {self.operator!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PodAffinityTerm:
+    """Inter-pod (anti-)affinity term — upstream PodAffinityTerm reduced
+    to a label-equality selector over existing pods plus a topology key
+    (ref ``plugins/podaffinity``, upstream InterPodAffinity).
+
+    ``topology_key`` names a Topology level label; an unknown key means
+    per-node (hostname) granularity.  ``required=False`` terms contribute
+    score instead of filtering.
+    """
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    topology_key: str = "kubernetes.io/hostname"
+    anti: bool = False
+    required: bool = True
+
+    def selects(self, labels: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels)
 
 
 @dataclasses.dataclass
@@ -194,13 +298,29 @@ class PodGroup:
     queue: str
     min_member: int = 1
     priority: int = 0
+    #: object labels — the shard partition selector matches these (ref
+    #: SchedulingNodePoolParams.GetLabelSelector, conf/scheduler_conf.go:96)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
     preemptibility: Preemptibility = Preemptibility.PREEMPTIBLE
     topology_constraint: TopologyConstraint | None = None
     sub_groups: list[SubGroup] = dataclasses.field(default_factory=list)
-    #: backoff: number of scheduling cycles to skip after repeated failure —
-    #: ref podgroup_types.go ``SchedulingBackoff``.
-    scheduling_backoff: int = 0
+    #: number of failed scheduling cycles before the group is marked
+    #: unschedulable — ref podgroup_types.go:69-70 ``SchedulingBackoff``
+    #: (the reference supports -1 = never and 1; any positive value works
+    #: here).  See ``utils/pod_group_utils.go`` NoSchedulingBackoff.
+    scheduling_backoff: int = -1
     creation_timestamp: float = 0.0
+    # --- status (written by the scheduler / podgroup controller) ---------
+    #: consecutive cycles every action failed to place the group
+    fit_failures: int = 0
+    #: the UnschedulableOnNodePool condition: the snapshot skips the group
+    #: until the condition is cleared (pod-set or capacity change)
+    unschedulable: bool = False
+    #: human-readable fit failure explanation — ref api/unschedule_info.go
+    unschedulable_reason: str = ""
+    #: pending-pod count observed when the condition was last evaluated —
+    #: pod churn clears the unschedulable mark (podgroup controller)
+    observed_pending: int = -1
     #: wall-clock the gang became running (for minruntime protection)
     last_start_timestamp: float | None = None
     #: status maintained by the podgroup controller
@@ -221,6 +341,7 @@ class Node:
     name: str
     allocatable: ResourceVec = dataclasses.field(default_factory=ResourceVec)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    taints: list["Taint"] = dataclasses.field(default_factory=list)
     #: accelerator memory per device, GiB (for memory-based sharing)
     accel_memory_gib: float = 16.0
     unschedulable: bool = False
@@ -293,6 +414,11 @@ class PlacementStrategy(str, enum.Enum):
     SPREAD = "spread"
 
 
+#: label key partitioning nodes/pod-groups into shards (ref the
+#: --nodepool-label-key flag default)
+NODE_POOL_LABEL_KEY = "kai.scheduler/node-pool"
+
+
 @dataclasses.dataclass
 class SchedulingShard:
     """One scheduler instance over a node-pool partition.
@@ -301,6 +427,9 @@ class SchedulingShard:
     """
 
     name: str = "default"
+    #: nodes/pod-groups whose NODE_POOL_LABEL_KEY label equals this value
+    #: belong to the shard; None = the default shard (objects WITHOUT the
+    #: label — ref SchedulingNodePoolParams DoesNotExist selector)
     partition_label_value: str | None = None
     placement_strategy_accel: PlacementStrategy = PlacementStrategy.BINPACK
     placement_strategy_cpu: PlacementStrategy = PlacementStrategy.BINPACK

@@ -23,6 +23,16 @@ class PodGroupController:
             pods = cluster.pods_of_group(group.name)
             active = sum(p.status in _ACTIVE for p in pods)
             running = sum(p.status == apis.PodStatus.RUNNING for p in pods)
+            pending = sum(p.status == apis.PodStatus.PENDING for p in pods)
+
+            # clear the UnschedulableOnNodePool condition when the group's
+            # pod set changes shape (ref: the condition is re-evaluated on
+            # pod churn; a resubmitted/scaled workload gets a fresh try)
+            if group.unschedulable and pending != group.observed_pending:
+                group.unschedulable = False
+                group.fit_failures = 0
+                group.unschedulable_reason = ""
+            group.observed_pending = pending
 
             attained = group.phase in (apis.PodGroupPhase.SCHEDULED,
                                        apis.PodGroupPhase.RUNNING,
@@ -43,4 +53,8 @@ class PodGroupController:
                 group.phase = apis.PodGroupPhase.STALE
             else:
                 group.stale_since = None
-                group.phase = apis.PodGroupPhase.PENDING
+                # the scheduler's UnschedulableOnNodePool condition owns
+                # the phase while it stands (cleared above on pod churn)
+                group.phase = (apis.PodGroupPhase.UNSCHEDULABLE
+                               if group.unschedulable
+                               else apis.PodGroupPhase.PENDING)

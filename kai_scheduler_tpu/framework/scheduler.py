@@ -132,27 +132,101 @@ class SchedulerConfig:
                                 "preempt", "stalegangeviction")
     session: SessionConfig = dataclasses.field(default_factory=SessionConfig)
     schedule_period_s: float = 1.0
+    #: the shard this instance serves: filters the snapshot to the
+    #: shard's node-pool partition and applies the shard's args
+    #: (placement strategy, k_value, queue depth) — ref SchedulingShard
+    shard: apis.SchedulingShard | None = None
+    node_pool_label_key: str = apis.NODE_POOL_LABEL_KEY
+
+
+def apply_shard_args(session: SessionConfig,
+                     shard: apis.SchedulingShard) -> SessionConfig:
+    """Render a shard's args over the base session config — the operator's
+    per-shard config rendering (ref ``schedulingshard_types.go:34-64``)."""
+    from ..ops.scoring import PlacementConfig
+    placement = PlacementConfig(
+        binpack_accel=(shard.placement_strategy_accel
+                       == apis.PlacementStrategy.BINPACK),
+        binpack_cpu=(shard.placement_strategy_cpu
+                     == apis.PlacementStrategy.BINPACK))
+    return dataclasses.replace(
+        session,
+        k_value=shard.k_value,
+        allocate=dataclasses.replace(
+            session.allocate, placement=placement,
+            queue_depth=shard.queue_depth_per_action.get(
+                "allocate", session.allocate.queue_depth)),
+        victims=dataclasses.replace(
+            session.victims,
+            queue_depth=shard.queue_depth_per_action.get(
+                "reclaim", session.victims.queue_depth)))
 
 
 class Scheduler:
-    """The cycle driver.  One instance per SchedulingShard."""
+    """The cycle driver.  One instance per SchedulingShard.
 
-    def __init__(self, config: SchedulerConfig | None = None):
+    ``usage_lister`` (optional, a ``runtime.usagedb.UsageLister``) feeds
+    time-based fairshare: each cycle polls it and threads the normalized
+    per-queue usage into the snapshot, where the proportion kernel's
+    ``k_value`` term consumes it (ref ``cache/usagedb``).
+    """
+
+    def __init__(self, config: SchedulerConfig | None = None,
+                 usage_lister=None):
         self.config = config or SchedulerConfig()
+        if self.config.shard is not None:
+            self.config = dataclasses.replace(
+                self.config,
+                session=apply_shard_args(self.config.session,
+                                         self.config.shard))
+        self.usage_lister = usage_lister
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
+    def _shard_filter(self, nodes, queues, groups, pods, topology):
+        """Restrict the snapshot to this shard's partition (ref
+        ``SchedulingNodePoolParams.GetLabelSelector``): label == value,
+        or label-absent for the default (value-less) shard."""
+        shard = self.config.shard
+        key = self.config.node_pool_label_key
+        if shard is None:
+            return nodes, queues, groups, pods, topology
+        val = shard.partition_label_value
+
+        def selects(labels: dict) -> bool:
+            # empty-string label values are legal: only None means "the
+            # default shard" (label-absent selector)
+            if val is None:
+                return key not in labels
+            return labels.get(key) == val
+
+        nodes = [n for n in nodes if selects(n.labels)]
+        groups = [g for g in groups if selects(g.labels)]
+        keep = {g.name for g in groups}
+        pods = [p for p in pods if p.group in keep]
+        return nodes, queues, groups, pods, topology
+
     def run_once(self, cluster: Cluster) -> CycleResult:
         """One scheduling cycle: snapshot → actions → commit set."""
+        from . import metrics
         t0 = time.perf_counter()
+        queue_usage = None
+        if self.usage_lister is not None:
+            self.usage_lister.maybe_fetch(cluster.now)
+            queue_usage = self.usage_lister.queue_usage(cluster.now)
         session = Session.open(
-            *cluster.snapshot_lists(), config=self.config.session,
-            now=cluster.now)
+            *self._shard_filter(*cluster.snapshot_lists()),
+            config=self.config.session,
+            now=cluster.now, queue_usage=queue_usage)
+        metrics.open_session_latency.observe(
+            value=time.perf_counter() - t0)
         result = CycleResult(tensors=init_result(session.state))
         for name, action in self._actions:
             ta = time.perf_counter()
             action(session, result)
             result.action_seconds[name] = time.perf_counter() - ta
+            metrics.action_latency.observe(
+                name, value=result.action_seconds[name])
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
         result.bind_requests = session.bind_requests_from(result.tensors)
@@ -171,5 +245,67 @@ class Scheduler:
                     rebind = session.move_bind_request(pod, ev.move_to)
                     result.move_bind_requests.append(rebind)
                     cluster.create_bind_request(rebind)
+        self._record_fit_status(cluster, session, result)
+        self._record_metrics(session, result)
         result.session_seconds = time.perf_counter() - t0
+        metrics.e2e_latency.observe(value=result.session_seconds)
         return result
+
+    def _record_metrics(self, session: Session,
+                        result: CycleResult) -> None:
+        """Per-cycle metric updates (ref metrics.go counters/gauges)."""
+        import numpy as np
+
+        from . import metrics
+        from ..apis.types import RESOURCE_NAMES
+        tensors = result.tensors
+        metrics.podgroups_considered.inc(
+            by=float(np.asarray(tensors.attempted).sum()))
+        metrics.podgroups_scheduled.inc(
+            "all", by=float(np.asarray(tensors.allocated).sum()))
+        # one bulk device→host transfer, then plain dict writes; skip
+        # unchanged gauge values to keep the cycle path O(changed)
+        fs = np.asarray(session.state.queues.fair_share)
+        alloc = np.asarray(tensors.queue_allocated)
+        usage = np.asarray(session.state.queues.usage)
+        for gauge, table in ((metrics.queue_fair_share, fs),
+                             (metrics.queue_allocated, alloc),
+                             (metrics.queue_usage, usage)):
+            for qi, qname in enumerate(session.index.queue_names):
+                for ri, rname in enumerate(RESOURCE_NAMES):
+                    v = float(table[qi, ri])
+                    if gauge.value(qname, rname) != v:
+                        gauge.set(qname, rname, value=v)
+
+    def _record_fit_status(self, cluster: Cluster, session: Session,
+                           result: CycleResult) -> None:
+        """Write fit failures back to PodGroup status — the
+        status_updater's UnschedulableOnNodePool marking (ref
+        ``cache/status_updater``, ``utils/pod_group_utils.go``): after
+        ``scheduling_backoff`` consecutive failed cycles the group is
+        marked unschedulable and the snapshot skips it until pod churn
+        clears the condition (podgroup controller)."""
+        import numpy as np
+        allocated = np.asarray(result.tensors.allocated)
+        explanations = session.unschedulable_explanations(result.tensors)
+        names = session.index.gang_names
+        # touch only gangs whose status actually changed: successes reset,
+        # failures (the explanations keys) accumulate — O(changed), not
+        # O(G) Python work on the cycle path
+        for gi in np.nonzero(allocated[:len(names)])[0]:
+            group = cluster.pod_groups.get(names[gi])
+            if group is not None and (group.fit_failures
+                                      or group.unschedulable):
+                group.fit_failures = 0
+                group.unschedulable = False
+                group.unschedulable_reason = ""
+        for name, reason in explanations.items():
+            group = cluster.pod_groups.get(name)
+            if group is None:
+                continue
+            group.fit_failures += 1
+            group.unschedulable_reason = reason
+            if (group.scheduling_backoff >= 1
+                    and group.fit_failures >= group.scheduling_backoff):
+                group.unschedulable = True
+                group.phase = apis.PodGroupPhase.UNSCHEDULABLE

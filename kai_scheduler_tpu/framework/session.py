@@ -33,6 +33,11 @@ class SessionConfig:
 
     allocate: AllocateConfig = dataclasses.field(default_factory=AllocateConfig)
     victims: VictimConfig = dataclasses.field(default_factory=VictimConfig)
+    #: derive kernel fast-path flags (track_devices / uniform_tasks) from
+    #: the snapshot shape at session open — a snapshot with no fractional
+    #: requests skips the per-device bookkeeping, and one whose gangs are
+    #: all identical replicas uses the whole-gang placement kernel
+    auto_tune: bool = True
     #: queue-hierarchy depth for fair-share recursion / capacity walks
     num_levels: int = 2
     #: proportion plugin kValue (time-based fairshare coupling)
@@ -65,6 +70,23 @@ class Session:
         config = config or SessionConfig()
         state, index = build_snapshot(
             nodes, queues, pod_groups, pods, topology, **snapshot_kwargs)
+        if config.auto_tune:
+            devices = index.needs_device_table
+            uniform = index.uniform_gangs and not devices
+            topo = index.has_required_topology
+            sub_topo = index.has_subgroup_topology
+            config = dataclasses.replace(
+                config,
+                allocate=dataclasses.replace(
+                    config.allocate, track_devices=devices,
+                    uniform_tasks=uniform, topology=topo,
+                    subgroup_topology=sub_topo),
+                victims=dataclasses.replace(
+                    config.victims,
+                    placement=dataclasses.replace(
+                        config.victims.placement, track_devices=devices,
+                        uniform_tasks=uniform, topology=topo,
+                        subgroup_topology=sub_topo)))
         fair_share = drf.set_fair_share(
             state, num_levels=config.num_levels, k_value=config.k_value)
         state = state.replace(queues=state.queues.replace(fair_share=fair_share))
@@ -136,6 +158,27 @@ class Session:
                     move_to = self.index.node_names[int(moves[mi])]
                 out.append(apis.Eviction(pod_name=name, group=group,
                                          move_to=move_to))
+        return out
+
+    #: fit_reason code → message (ref ``api/unschedule_info.go`` fit errors)
+    FIT_REASONS = {
+        1: ("no node satisfies the pod requirements "
+            "(resources / selector / taints / affinity)"),
+        2: "an equivalent pod group already failed this cycle",
+        3: "placement attempt failed (capacity or queue gates)",
+    }
+
+    def unschedulable_explanations(
+            self, result: AllocationResult) -> dict[str, str]:
+        """Per-gang fit-failure messages for gangs that ended the cycle
+        unplaced — the UnschedulableExplanation surface."""
+        reasons = np.asarray(result.fit_reason)
+        allocated = np.asarray(result.allocated)
+        out: dict[str, str] = {}
+        for gi, name in enumerate(self.index.gang_names):
+            code = int(reasons[gi])
+            if code and not allocated[gi]:
+                out[name] = self.FIT_REASONS.get(code, f"code {code}")
         return out
 
     def move_bind_request(self, pod: apis.Pod,

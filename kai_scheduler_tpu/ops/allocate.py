@@ -47,8 +47,8 @@ from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .predicates import feasible_nodes, feasible_nodes_dual, node_portion
-from .scoring import (W_TOPOLOGY, PlacementConfig, gpu_sharing_score,
-                      pick_device, score_nodes_for_task)
+from .scoring import (W_NOMINATED, W_TOPOLOGY, PlacementConfig,
+                      gpu_sharing_score, pick_device, score_nodes_for_task)
 
 EPS = 1e-6
 
@@ -90,6 +90,11 @@ class AllocationResult(struct.PyTreeNode):
     #: equivalent of the pipelined BindRequest the reference creates for
     #: re-placed consolidation victims
     victim_move: jax.Array
+    #: why a gang was not placed this cycle (ref ``api/unschedule_info.go``
+    #: fit errors): 0 = placed/not tried, 1 = feasibility prefilter (no
+    #: nodes for its tasks), 2 = an equivalent gang already failed
+    #: (signature skip), 3 = placement attempt failed — i32 [G]
+    fit_reason: jax.Array
 
 
 def init_result(state: ClusterState) -> AllocationResult:
@@ -110,6 +115,7 @@ def init_result(state: ClusterState) -> AllocationResult:
         queue_allocated_nonpreemptible=q.allocated_nonpreemptible,
         victim=jnp.zeros((state.running.m,), bool),
         victim_move=jnp.full((state.running.m,), -1, jnp.int32),
+        fit_reason=jnp.zeros((G,), jnp.int32),
     )
 
 
@@ -169,8 +175,9 @@ class AllocateConfig:
     """Knobs of the allocate action (ref CLI flags + SchedulingShard)."""
 
     placement: PlacementConfig = PlacementConfig()
-    #: max gangs attempted per cycle — ref ``QueueDepthPerAction``;
-    #: None = all valid gangs.
+    #: max gangs attempted per QUEUE this action — ref
+    #: ``QueueDepthPerAction`` ("max number of jobs to try for action per
+    #: queue", ``conf/scheduler_conf.go:56``); None = unlimited.
     queue_depth: int | None = None
     #: re-sort the queue heap every wavefront chunk (the tensorized
     #: equivalent of the reference's dynamic two-level heap, which
@@ -199,6 +206,24 @@ class AllocateConfig:
     #: sequential task steps.  Requires ``track_devices=False``.  Session
     #: derives this from the snapshot automatically.
     uniform_tasks: bool = False
+    #: whole-gang feasibility prefilter over the task-type table — gangs
+    #: with no feasible nodes for ``min_needed`` tasks are never attempted
+    #: (ref ``actions/common/feasible_nodes.go:11`` FeasibleNodesForJob)
+    prefilter: bool = True
+    #: compile the required-level topology domain loop.  False when the
+    #: snapshot holds no topology-required gang — lax.cond compiles BOTH
+    #: branches, and the domain loop embeds a second copy of the task
+    #: kernel, so skipping it roughly halves compile time.  Session
+    #: derives this from the snapshot automatically.
+    topology: bool = True
+    #: compile the per-SUBGROUP required-level machinery (domain locks +
+    #: capacity-aware first placement, an O(N) segment reduction per task
+    #: step).  False when no gang declares subgroup topology constraints.
+    #: Session derives this from the snapshot automatically.
+    subgroup_topology: bool = True
+    #: skip gangs whose scheduling signature already failed this action —
+    #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
+    signature_skip: bool = True
 
 
 def _attempt_gang_in_domain(
@@ -212,7 +237,9 @@ def _attempt_gang_in_domain(
         extra_releasing: jax.Array,        # f32 [N, R] victim-freed capacity
         extra_device_releasing: jax.Array, # f32 [N, D]
         lane: jax.Array,               # i32 [] wavefront lane (tie-break)
-        chain: jax.Array               # bool [Q, Q] ancestor membership
+        chain: jax.Array,              # bool [Q, Q] ancestor membership
+        prior_nodes: jax.Array | None = None,  # i32 [T] prior placements
+        quota: jax.Array | None = None     # i32 [] max new placements
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -239,13 +266,86 @@ def _attempt_gang_in_domain(
     T = g.t
     D = n.d
     N = n.n
+    L = n.topology.shape[1]
     task_req = g.task_req[gang_idx]          # [T, R]
     task_valid = g.task_valid[gang_idx]      # [T]
     task_sel = g.task_selector[gang_idx]     # [T, K]
     task_portion = g.task_portion[gang_idx]  # [T]
     task_mem = g.task_accel_mem[gang_idx]    # [T]
+    task_class = g.task_filter_class[gang_idx]  # [T]
+    task_nom = g.task_nominated[gang_idx]    # [T]
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
+    # gang-internal anti-affinity: no two tasks in the same domain at
+    # this level (asl == L means per-node)
+    asl = g.anti_self_level[gang_idx]
+    has_asl = asl >= 0
+    doms_self = jnp.where(asl >= L, jnp.arange(N),
+                          n.topology[:, jnp.clip(asl, 0, L - 1)])       # [N]
+    # re-push protocol (ref allocate.go:102-104 + getNumTasksToAllocate):
+    # an attempt places at most ``quota`` new tasks, skipping tasks a
+    # prior attempt already placed; its goal is min(quota, unplaced) and
+    # success is all-or-nothing on that chunk.  Legacy callers (victim
+    # solver) pass neither and keep quorum semantics.
+    legacy = prior_nodes is None and quota is None
+    if prior_nodes is None:
+        prior_nodes = jnp.full((T,), -1, jnp.int32)
+    if quota is None:
+        quota = jnp.asarray(T, jnp.int32)
+    already = prior_nodes >= 0                                          # [T]
+    unplaced_t = task_valid & ~already
+    unplaced = jnp.sum(unplaced_t.astype(jnp.int32))
+    # seed cross-attempt state from prior placements: anti-self domains
+    # and the preferred-level locality anchor
+    prior_doms = doms_self[jnp.maximum(prior_nodes, 0)]                 # [T]
+    forbidden0 = has_asl & jnp.any(
+        (doms_self[:, None] == prior_doms[None, :]) & already[None, :],
+        axis=1)                                                         # [N]
+    first_prior = jnp.argmax(already)
+    pref_dom0 = jnp.where(
+        jnp.any(already),
+        pref_doms[jnp.maximum(prior_nodes[first_prior], 0)], -1)
+
+    # --- hierarchical subgroups (ref allocateSubGroupSet + the per-
+    # subgroup chunks of GetTasksToAllocate): an attempt's eligible task
+    # set is, while ANY subgroup is below quorum, the union of per-
+    # subgroup quorum chunks (+ extra tasks when the gang's own minMember
+    # exceeds the subgroup sum); once quorate, one scale-up task.
+    S = g.s
+    sub = g.task_subgroup[gang_idx]                                     # [T]
+    sub_need = g.subgroup_min_needed[gang_idx]                          # [S]
+    srl = g.subgroup_required_level[gang_idx]                           # [S]
+    already_s = jax.ops.segment_sum(
+        already.astype(jnp.int32), sub, num_segments=S)                 # [S]
+    deficit = jnp.maximum(sub_need - already_s, 0)                      # [S]
+    in_quorum = jnp.any(deficit > 0) | (
+        jnp.sum(already.astype(jnp.int32)) <
+        g.min_needed[gang_idx])
+    earlier_same_sub = ((sub[None, :] == sub[:, None])
+                        & (jnp.arange(T)[None, :] < jnp.arange(T)[:, None]))
+    rank_in_sub = jnp.sum(earlier_same_sub & unplaced_t[None, :], axis=1)
+    elig_quorum = unplaced_t & (rank_in_sub < deficit[sub])             # [T]
+    # extra tasks to honour a gang minMember above the subgroup sum
+    extra_needed = jnp.maximum(
+        g.min_needed[gang_idx] - jnp.sum(already.astype(jnp.int32))
+        - jnp.sum(deficit), 0)
+    rest = unplaced_t & ~elig_quorum
+    rank_rest = jnp.cumsum(rest.astype(jnp.int32)) - 1
+    elig_quorum = elig_quorum | (rest & (rank_rest < extra_needed))
+    first_unplaced = unplaced_t & (
+        jnp.cumsum(unplaced_t.astype(jnp.int32)) - 1 < 1)
+    eligible_new = jnp.where(in_quorum, elig_quorum, first_unplaced)
+    goal = jnp.sum(eligible_new.astype(jnp.int32))
+    if legacy:
+        goal = jnp.minimum(quota, unplaced)
+    # remaining per-subgroup request of this attempt's chunk — steers a
+    # constrained subgroup's first placement into a domain big enough for
+    # the whole chunk (the tensor stand-in for allocateSubGroupSet's
+    # subset checkpoint/rollback search)
+    sub_rem0 = jax.ops.segment_sum(
+        jnp.where((eligible_new if not legacy else task_valid)[:, None],
+                  task_req, 0.0),
+        sub, num_segments=S)                                            # [S, R]
 
     # cyclic per-lane rotation, scaled well below the 1.0-resolution of
     # the score bands (density scores quantize coarsely on equal nodes)
@@ -263,7 +363,8 @@ def _attempt_gang_in_domain(
                           jnp.inf, state.queues.limit)          # [Q, R]
     quota_eff = jnp.where(state.queues.quota <= UNLIMITED + 0.5,
                           jnp.inf, state.queues.quota)
-    req_valid = jnp.where(task_valid[:, None], task_req, 0.0)   # [T, R]
+    eligible_t = task_valid if legacy else eligible_new         # [T]
+    req_valid = jnp.where(eligible_t[:, None], task_req, 0.0)   # [T, R]
     cum_req = jnp.cumsum(req_valid, axis=0)                     # [T, R]
     exempt = ~anc[None, :, None]
     gate_lim = jnp.all(
@@ -274,26 +375,62 @@ def _attempt_gang_in_domain(
         | exempt, axis=(1, 2))
     gate_t = gate_lim & jnp.where(nonpreempt, gate_quota, True)  # [T]
 
+    ND = N * L
+
     def task_body(t, carry):
-        free_l, dev_l, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom = carry
+        (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom, sub_rem,
+         nodes_t, dev_t, pipe_t, count, q_delta, pref_dom) = carry
         req = task_req[t]
         is_frac = (task_portion[t] > 0) | (task_mem[t] > 0)
-        ok = task_valid[t] & gate_t[t]
+        ok = eligible_t[t] & gate_t[t]
 
         fit_idle, fit_pipe = feasible_nodes_dual(
             n, req, task_sel[t], task_portion[t], task_mem[t],
             free=free_l, device_free=dev_l,
             extra_releasing=extra_releasing,
             extra_device_releasing=extra_device_releasing,
-            devices=config.track_devices)
-        fit_idle = fit_idle & domain_mask
-        fit_pipe = fit_pipe & domain_mask                              # [N]
+            devices=config.track_devices,
+            task_class=task_class[t])
+        allowed = domain_mask & ~forbidden
+        # per-subgroup required level: once the subgroup's first task
+        # lands, its whole domain at that level is locked for the rest
+        # (greedy domain choice; gang-level domains retry via the outer
+        # domain loop) — ref allocateSubGroupSet per-subgroup subsets
+        s_t = sub[t]
+        level_t = srl[s_t]
+        has_srl = level_t >= 0
+        dom_col = jnp.take(n.topology, jnp.clip(level_t, 0, L - 1),
+                           axis=1)                                     # [N]
+        locked = sub_dom[s_t]
+        if config.subgroup_topology:
+            allowed = allowed & (
+                ~has_srl | (locked < 0) | (dom_col == locked))
+            # a constrained subgroup's FIRST placement must pick a domain
+            # whose aggregate capacity still fits the subgroup's
+            # remaining chunk, or the lock would doom the attempt
+            needs_pick = has_srl & (locked < 0)
+            avail_pipe = free_l + n.releasing + extra_releasing        # [N, R]
+            dom_seg = jnp.where(n.valid & (dom_col >= 0), dom_col, ND)
+            agg = jax.ops.segment_sum(
+                jnp.where(n.valid[:, None], avail_pipe, 0.0), dom_seg,
+                num_segments=ND + 1)[:ND]                              # [ND, R]
+            dom_ok = jnp.all(
+                agg[jnp.maximum(dom_col, 0)] + EPS
+                >= sub_rem[s_t][None, :],
+                axis=-1) & (dom_col >= 0)
+            allowed = allowed & (~needs_pick | dom_ok)
+        fit_idle = fit_idle & allowed
+        fit_pipe = fit_pipe & allowed                                  # [N]
         # preferred-level locality band (topology plugin node scoring):
         # stick with the domain of the gang's first-placed task.
         topo_band = jnp.where(
             has_pref & (pref_dom >= 0) & (pref_doms == pref_dom),
             W_TOPOLOGY, 0.0)                                           # [N]
-        extra_bands = topo_band + tie_jitter
+        # soft filter bands (PreferNoSchedule / preferred pod-affinity)
+        # + the nominatednode plugin's dominating bonus
+        extra_bands = (topo_band + tie_jitter + n.soft_scores[task_class[t]]
+                       + jnp.where(jnp.arange(N) == task_nom[t],
+                                   W_NOMINATED, 0.0))
         if config.track_devices:
             portion_n = node_portion(n, task_portion[t], task_mem[t])  # [N]
             extra_bands = extra_bands + gpu_sharing_score(
@@ -333,6 +470,8 @@ def _attempt_gang_in_domain(
                 take_whole.astype(dev_row.dtype))
             dev_delta = jnp.where(placed, dev_delta, 0.0)
             dev_l = dev_l.at[node].add(-dev_delta)
+            dev_bind = dev_bind.at[node].add(
+                jnp.where(is_pipe, 0.0, dev_delta))
         else:
             p = req[0]
             frac_dev = jnp.asarray(-1, jnp.int32)
@@ -343,7 +482,20 @@ def _attempt_gang_in_domain(
         delta_node = delta.at[0].set(
             jnp.where(placed, jnp.where(is_frac, p, req[0]), 0.0))
         free_l = free_l.at[node].add(-delta_node)
+        # bind-now claims tracked separately: the wavefront accept check
+        # must verify that *immediately bound* tasks collectively fit the
+        # chunk-start idle pool (pipelined tasks legitimately overdraw it)
+        bind_used = bind_used.at[node].add(
+            jnp.where(is_pipe, 0.0, delta_node))
         q_delta = q_delta + delta
+        # anti-self: the chosen node's whole domain is off-limits for the
+        # gang's remaining tasks
+        forbidden = forbidden | (
+            has_asl & placed & (doms_self == doms_self[node]))
+        sub_dom = sub_dom.at[s_t].set(
+            jnp.where(placed & has_srl & (locked < 0), dom_col[node],
+                      locked))
+        sub_rem = sub_rem.at[s_t].add(-jnp.where(placed, req, 0.0))
         nodes_t = nodes_t.at[t].set(jnp.where(placed, node, -1))
         dev_t = dev_t.at[t].set(
             jnp.where(placed & is_frac, frac_dev, -1))
@@ -351,24 +503,44 @@ def _attempt_gang_in_domain(
         count = count + placed.astype(jnp.int32)
         pref_dom = jnp.where(placed & (pref_dom < 0), pref_doms[node],
                              pref_dom)
-        return free_l, dev_l, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom
+        return (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom,
+                sub_rem, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom)
+
+    # seed subgroup domain locks from prior placements
+    prior_level = srl[sub]                                              # [T]
+    prior_sub_dom = n.topology[jnp.maximum(prior_nodes, 0),
+                               jnp.clip(prior_level, 0, L - 1)]         # [T]
+    sub_dom0 = jnp.full((S,), -1, jnp.int32).at[sub].max(
+        jnp.where(already & (prior_level >= 0), prior_sub_dom, -1))
 
     carry = (free, device_free,
+             jnp.zeros_like(free), jnp.zeros_like(device_free),
+             forbidden0, sub_dom0, sub_rem0,
              jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
              jnp.zeros((T,), bool),
              jnp.asarray(0, jnp.int32), jnp.zeros_like(task_req[0]),
-             jnp.asarray(-1, jnp.int32))
-    for t in range(T):  # static unroll — see docstring
-        carry = task_body(t, carry)
-    free2, dev2, nodes_t, dev_t, pipe_t, count, q_delta, _ = carry
+             pref_dom0.astype(jnp.int32))
+    # fori_loop, not a static unroll: the task step's graph is large and
+    # appears in several kernel variants (wavefront lanes, domain loop,
+    # victim solver) — unrolling T copies made compile time the suite's
+    # bottleneck while saving only ~µs of loop overhead per step
+    carry = lax.fori_loop(0, T, task_body, carry)
+    (free2, dev2, bind_used, dev_bind, _, _, _, nodes_t, dev_t, pipe_t,
+     count, q_delta, _) = carry
     # queue accounting applied once for the whole gang along its chain
     qa2 = q_alloc + anc[:, None] * q_delta[None, :]
     qan2 = q_alloc_np + jnp.where(nonpreempt,
                                   anc[:, None] * q_delta[None, :], 0.0)
-    # min_needed (not min_member): pods already bound/running count toward
-    # the gang's quorum — elastic scale-up and pipelined-remainder gangs.
-    success = count >= g.min_needed[gang_idx]
-    return free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success
+    if legacy:
+        # min_needed (not min_member): pods already bound/running count
+        # toward the gang's quorum — elastic scale-up and pipelined-
+        # remainder gangs (victim-solver semantics).
+        success = count >= g.min_needed[gang_idx]
+    else:
+        # re-push protocol: the attempt's chunk is all-or-nothing
+        success = (goal > 0) & (count >= goal)
+    return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
+            bind_used, dev_bind)
 
 
 def _attempt_gang_in_domain_uniform(
@@ -378,7 +550,9 @@ def _attempt_gang_in_domain_uniform(
         num_levels: int, config: AllocateConfig,
         domain_mask: jax.Array, pref_doms: jax.Array, has_pref: jax.Array,
         extra_releasing: jax.Array, extra_device_releasing: jax.Array,
-        lane: jax.Array, chain: jax.Array):
+        lane: jax.Array, chain: jax.Array,
+        prior_nodes: jax.Array | None = None,
+        quota: jax.Array | None = None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
     A gang whose T pending tasks are identical replicas (the dominant
@@ -396,11 +570,27 @@ def _attempt_gang_in_domain_uniform(
     T, N = g.t, n.n
     req = g.task_req[gang_idx, 0]                       # [R] the replica
     sel = g.task_selector[gang_idx, 0]                  # [K]
+    task_class = g.task_filter_class[gang_idx, 0]       # []
     task_valid = g.task_valid[gang_idx]                 # [T]
     tcount = jnp.sum(task_valid.astype(jnp.int32))
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
+    # per-node anti-self (one replica per node) is the only granularity
+    # this path supports — the snapshot builder gates uniform_gangs on it
+    one_per_node = g.anti_self_level[gang_idx] >= 0
     anc = chain[queue]                                  # [Q]
+    # re-push protocol (see _attempt_gang_in_domain)
+    legacy = prior_nodes is None and quota is None
+    if prior_nodes is None:
+        prior_nodes = jnp.full((T,), -1, jnp.int32)
+    if quota is None:
+        quota = jnp.asarray(T, jnp.int32)
+    already = prior_nodes >= 0
+    already_count = jnp.sum(already.astype(jnp.int32))
+    unplaced = tcount - already_count
+    goal = jnp.minimum(quota, unplaced)
+    prior_on_node = jnp.zeros((N,), jnp.int32).at[
+        jnp.maximum(prior_nodes, 0)].add(already.astype(jnp.int32)) > 0
 
     tie_jitter = (-1e-4 / N) * jnp.mod(
         jnp.arange(N) - lane, N).astype(jnp.float32)    # [N]
@@ -431,7 +621,8 @@ def _attempt_gang_in_domain_uniform(
         n, req, sel, zero, zero,
         free=free, device_free=device_free,
         extra_releasing=extra_releasing,
-        extra_device_releasing=extra_device_releasing, devices=False)
+        extra_device_releasing=extra_device_releasing, devices=False,
+        task_class=task_class)
     fit_idle = fit_idle & domain_mask
     fit_pipe = fit_pipe & domain_mask
 
@@ -440,7 +631,11 @@ def _attempt_gang_in_domain_uniform(
                       (avail + EPS) / jnp.maximum(req, EPS)[None, :],
                       jnp.inf)                          # [N, R]
         c = jnp.floor(jnp.min(c, axis=-1))
-        return jnp.where(mask, jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+        c = jnp.where(mask, jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+        # anti-self: one replica per node, and nodes holding a replica
+        # from a prior attempt are off-limits
+        c = jnp.where(one_per_node & prior_on_node, 0, c)
+        return jnp.where(one_per_node, jnp.minimum(c, 1), c)
 
     c_pipe = copies(free + n.releasing + extra_releasing, fit_pipe)  # [N]
     c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
@@ -448,40 +643,53 @@ def _attempt_gang_in_domain_uniform(
     # ---- scores (one pass; locality band anchored at the best node) -----
     scores0 = score_nodes_for_task(
         n, free, req, fit_idle, fit_pipe, config.placement,
-        extra=tie_jitter)                               # [N]
+        extra=tie_jitter + n.soft_scores[task_class])   # [N]
     best = jnp.argmax(scores0)
     topo_band = jnp.where(
         has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
     scores = jnp.where(fit_pipe, scores0 + topo_band, scores0)
 
     # ---- greedy fill by score order -------------------------------------
-    order = jnp.argsort(-scores)                        # [N]
+    # top_k instead of a full argsort: at most T replicas place and every
+    # feasible node holds >= 1 (c_pipe >= 1 where fit), so the T best-
+    # scoring nodes are exactly the prefix the full sort would fill —
+    # O(N log T) instead of O(N log N) per lane, the hot win at 10k nodes
+    k = min(T, N)
+    _, order = jax.lax.top_k(scores, k)                 # [k]
     feas_sorted = fit_pipe[order]
     c_sorted = jnp.where(feas_sorted, c_pipe[order], 0)
-    want = jnp.minimum(tcount, m_gate)
-    cum = jnp.cumsum(c_sorted)                          # [N]
+    want = jnp.minimum(goal if not legacy else tcount, m_gate)
+    cum = jnp.cumsum(c_sorted)                          # [k]
     placed_sorted = jnp.clip(want - (cum - c_sorted), 0, c_sorted)
-    total_placed = jnp.minimum(
-        cum[-1] if N > 0 else jnp.asarray(0), want)
+    total_placed = jnp.minimum(cum[-1], want)
 
-    tpos = jnp.arange(T, dtype=jnp.int32)
-    sidx = jnp.searchsorted(cum, tpos, side="right")    # [T]
-    sidx = jnp.minimum(sidx, N - 1)
-    placed_t = task_valid & (tpos < total_placed)
+    # new placements land in the first `total_placed` still-unplaced slots
+    elig_rank = jnp.cumsum((task_valid & ~already).astype(jnp.int32)) - 1
+    npos = jnp.where(task_valid & ~already, elig_rank, T)   # [T]
+    sidx = jnp.searchsorted(cum, npos, side="right")    # [T]
+    sidx = jnp.minimum(sidx, k - 1)
+    placed_t = task_valid & ~already & (npos < total_placed)
     nodes_t = jnp.where(placed_t, order[sidx], -1)
     # within a node the first c_idle replicas bind now, the rest pipeline
-    rank_in_node = tpos - (cum[sidx] - c_sorted[sidx])
+    rank_in_node = npos - (cum[sidx] - c_sorted[sidx])
     pipe_t = placed_t & (rank_in_node >= c_idle[order[sidx]])
 
-    placed_per_node = jnp.zeros((N,), jnp.int32).at[order].set(placed_sorted)
+    placed_per_node = jnp.zeros((N,), jnp.int32).at[order].add(placed_sorted)
     free2 = free - placed_per_node[:, None].astype(free.dtype) * req[None, :]
+    # replicas past a node's idle headroom pipeline; the rest bind now
+    bind_per_node = jnp.minimum(placed_per_node, c_idle)
+    bind_used = bind_per_node[:, None].astype(free.dtype) * req[None, :]
     q_delta = total_placed.astype(free.dtype) * req
     qa2 = q_alloc + anc[:, None] * q_delta[None, :]
     qan2 = q_alloc_np + jnp.where(nonpreempt,
                                   anc[:, None] * q_delta[None, :], 0.0)
-    success = total_placed >= g.min_needed[gang_idx]
+    if legacy:
+        success = total_placed >= g.min_needed[gang_idx]
+    else:
+        success = (goal > 0) & (total_placed >= goal)
     dev_t = jnp.full((T,), -1, jnp.int32)
-    return free2, device_free, qa2, qan2, nodes_t, dev_t, pipe_t, success
+    return (free2, device_free, qa2, qan2, nodes_t, dev_t, pipe_t, success,
+            bind_used, jnp.zeros_like(device_free))
 
 
 def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
@@ -491,7 +699,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   extra_releasing: jax.Array | None = None,
                   extra_device_releasing: jax.Array | None = None,
                   lane: jax.Array | None = None,
-                  chain: jax.Array | None = None):
+                  chain: jax.Array | None = None,
+                  prior_nodes: jax.Array | None = None,
+                  quota: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -534,7 +744,11 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         return in_domain(
             state, gang_idx, free, device_free, q_alloc, q_alloc_np,
             num_levels, config, n.valid, pref_doms, has_pref,
-            extra_releasing, extra_device_releasing, lane, chain)
+            extra_releasing, extra_device_releasing, lane, chain,
+            prior_nodes, quota)
+
+    if not config.topology:
+        return unconstrained(None)
 
     def constrained(_):
         doms = n.topology[:, jnp.maximum(rl, 0)]               # [N]
@@ -558,7 +772,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         empty = (free, device_free, q_alloc, q_alloc_np,
                  jnp.full((T,), -1, jnp.int32),
                  jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
-                 jnp.asarray(False))
+                 jnp.asarray(False),
+                 jnp.zeros_like(free), jnp.zeros_like(device_free))
 
         def cond(carry):
             tried, done, _ = carry
@@ -575,8 +790,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
             out = in_domain(
                 state, gang_idx, free, device_free, q_alloc, q_alloc_np,
                 num_levels, config, doms == d, pref_doms, has_pref,
-                extra_releasing, extra_device_releasing, lane, chain)
-            success = out[-1]
+                extra_releasing, extra_device_releasing, lane, chain,
+                prior_nodes, quota)
+            success = out[7]
             best = jax.tree.map(
                 lambda nw, old: jnp.where(success, nw, old), out, best)
             return tried.at[d].set(True), success, best
@@ -617,40 +833,91 @@ def allocate(
     quota_eff = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
 
     remaining0 = g.valid & (g.backoff <= 0) & ~init.allocated
+    if config.prefilter:
+        # whole-gang feasibility over the task-type table: a gang whose
+        # min_needed tasks cannot each find ANY node (ignoring cross-task
+        # capacity interaction) is hopeless this cycle — at 50k pending
+        # gangs this is the difference between attempting everything and
+        # attempting only the schedulable frontier.  Cost: [Y, N] for the
+        # Y distinct task types, not [G, T, N].
+        type_fit = jax.vmap(lambda y: jnp.any(feasible_nodes(
+            n, g.type_req[y], g.type_selector[y], g.type_portion[y],
+            g.type_mem[y], task_class=g.type_class[y],
+            free=n.free + init.releasing_extra,
+            device_free=n.device_free + init.device_releasing_extra,
+            include_releasing=True)))(
+                jnp.arange(g.type_req.shape[0]))          # [Y]
+        task_ok = type_fit[g.task_type] & g.task_valid    # [G, T]
+        feas = jnp.sum(task_ok.astype(jnp.int32), -1) >= g.min_needed
+        pre_dropped = remaining0 & ~feas
+        remaining0 = remaining0 & feas
+        init = init.replace(
+            fit_reason=jnp.where(pre_dropped, 1, init.fit_reason))
     static_rank = None
-    if not config.dynamic_order or config.queue_depth is not None:
+    if not config.dynamic_order:
         order0 = ordering.job_order_perm(
             g, q, init.queue_allocated, fair_share, total, remaining0)
         static_rank = jnp.zeros((G,), jnp.float32).at[order0].set(
             jnp.arange(G, dtype=jnp.float32))
-    if config.queue_depth is not None:
-        # global attempt budget — ref QueueDepthPerAction
-        remaining0 = remaining0 & (static_rank < config.queue_depth)
 
     chain = _chain_membership(q.parent, num_levels)
 
-    def attempt_one(gi, lane, free, dev, qa, qan):
+    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
-                             config, extra, extra_dev, lane, chain)
+                             config, extra, extra_dev, lane, chain,
+                             prior_nodes=prior, quota=quota)
 
     def cond(carry):
-        res, remaining, fuel = carry
+        res, remaining, q_attempts, failed_sig, fuel = carry
         return jnp.any(remaining) & (fuel > 0)
 
     def chunk(carry):
-        res, remaining, fuel = carry
+        res, remaining, q_attempts, failed_sig, fuel = carry
         free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
                               res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
+            # fairness gate: while ANY under-fair-share queue still has
+            # remaining gangs, over-fair-share queues sit the chunk out.
+            # The reference's heap gives them the same treatment — an
+            # under-fs queue sorts strictly first and its (re-pushed)
+            # jobs drain before an over-fs queue is popped at all, so
+            # contested capacity goes to under-fs queues first.
+            over_fs = ordering.queue_order_keys(
+                q, qa, fair_share, total)[0] > 0.5                # [Q]
+            elig = remaining & ~over_fs[g.queue]
+            elig = jnp.where(jnp.any(elig), elig, remaining)
             order = ordering.job_order_perm(
-                g, q, qa, fair_share, total, remaining)
+                g, q, qa, fair_share, total, elig)
         else:
             # frozen keys, retired gangs pushed last (last lexsort key is
             # most significant)
+            elig = remaining
             order = jnp.lexsort(
                 (static_rank, (~remaining).astype(jnp.float32)))
         cand = order[:B]                                          # [B]
-        cand_valid = remaining[cand]
+        cand_valid = elig[cand]
+        if config.queue_depth is not None:
+            # per-queue attempt budget (ref QueueDepthPerAction): a
+            # candidate is eligible while its queue's prior attempts plus
+            # its rank among earlier same-queue candidates of this chunk
+            # stay under the depth.  Over-budget candidates simply sit out
+            # the chunk; fully exhausted queues drain below.
+            qc = g.queue[cand]                                    # [B]
+            earlier = (jnp.arange(B)[None, :] < jnp.arange(B)[:, None])
+            rank_q = jnp.sum(
+                (qc[None, :] == qc[:, None]) & earlier
+                & cand_valid[None, :], axis=1)                    # [B]
+            cand_valid = cand_valid & (
+                q_attempts[qc] + rank_q < config.queue_depth)
+
+        # re-push protocol (ref allocate.go:102-104): a below-quorum gang
+        # attempts its whole remaining quorum chunk; an at/above-quorum
+        # gang scales up ONE task per attempt and re-enters the heap, so
+        # elastic growth interleaves fairly with other queues' jobs.
+        prior_b = res.placements[cand]                            # [B, T]
+        placed_cnt = jnp.sum((prior_b >= 0).astype(jnp.int32), -1)
+        need = g.min_needed[cand]
+        quota_b = jnp.where(placed_cnt < need, need - placed_cnt, 1)
 
         # independent attempts against chunk-start state (the vmapped
         # replacement for the reference's one-job-at-a-time hot loop)
@@ -658,13 +925,16 @@ def allocate(
         # axis so a chunk of identical gangs fans out over equal-scoring
         # nodes instead of colliding on one
         lanes = jnp.arange(B, dtype=jnp.int32) * max(1, n.n // B)
-        free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b = \
-            jax.vmap(attempt_one, in_axes=(0, 0, None, None, None, None))(
-                cand, lanes, free, dev, qa, qan)
+        (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
+         bind_b, devbind_b) = \
+            jax.vmap(attempt_one,
+                     in_axes=(0, 0, 0, 0, None, None, None, None))(
+                cand, lanes, prior_b, quota_b, free, dev, qa, qan)
         succ_b = succ_b & cand_valid
 
         ok = succ_b[:, None, None]
         d_free = jnp.where(ok, free - free2_b, 0.0)               # [B, N, R]
+        d_bind = jnp.where(ok, bind_b, 0.0)                       # [B, N, R]
         d_qa = jnp.where(ok, qa2_b - qa, 0.0)                     # [B, Q, R]
         d_qan = jnp.where(ok, qan2_b - qan, 0.0)
 
@@ -672,9 +942,18 @@ def allocate(
         # are non-negative, so the per-prefix feasibility flags are
         # monotone and the accept mask IS the prefix mask.
         cum_free = jnp.cumsum(d_free, axis=0)
+        cum_bind = jnp.cumsum(d_bind, axis=0)
         cum_qa = jnp.cumsum(d_qa, axis=0)
         cum_qan = jnp.cumsum(d_qan, axis=0)
         ok_node = jnp.all(free[None] - cum_free >= rel_floor[None],
+                          axis=(1, 2))                            # [B]
+        # bind-now claims must collectively fit the chunk-start *idle*
+        # pool: each lane computed its pipelined flags against chunk-start
+        # free, so without this a later lane could bind immediately onto
+        # capacity another lane just consumed (capacity that is really
+        # still held by terminating pods).  Rejected lanes retry next
+        # chunk and re-derive their flags against the updated pool.
+        ok_bind = jnp.all(cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
                           axis=(1, 2))                            # [B]
         # capacity gates re-checked jointly; queues untouched by the
         # chunk (zero delta) are exempt — they may legitimately sit over
@@ -683,12 +962,17 @@ def allocate(
                         | (cum_qa <= EPS), axis=(1, 2))
         ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff[None] + EPS)
                          | (cum_qan <= EPS), axis=(1, 2))
-        accept = ok_node & ok_qa & ok_qan                         # [B]
+        accept = ok_node & ok_bind & ok_qa & ok_qan               # [B]
         if config.track_devices:
             d_dev = jnp.where(ok, dev - dev2_b, 0.0)              # [B, N, D]
+            d_devbind = jnp.where(ok, devbind_b, 0.0)
             cum_dev = jnp.cumsum(d_dev, axis=0)
+            cum_devbind = jnp.cumsum(d_devbind, axis=0)
             accept = accept & jnp.all(
                 dev[None] - cum_dev >= dev_floor[None], axis=(1, 2))
+            accept = accept & jnp.all(
+                cum_devbind <= jnp.maximum(dev[None], 0.0) + EPS,
+                axis=(1, 2))
 
         take = succ_b & accept
         w = take.astype(free.dtype)
@@ -701,35 +985,69 @@ def allocate(
         nodes_b = jnp.where(take[:, None], nodes_b, -1)
         devt_b = jnp.where(take[:, None], devt_b, -1)
         pipe_b = jnp.where(take[:, None], pipe_b, False)
-        # done: placed (take) or individually infeasible (failure is
-        # final — capacity only shrinks).  Conflict-rejected successes
-        # retry next chunk.
-        done_b = cand_valid & (take | ~succ_b)
+        new_cnt = jnp.sum((nodes_b >= 0).astype(jnp.int32), -1)   # [B]
+        total_cnt = placed_cnt + new_cnt
+        valid_cnt = jnp.sum(g.task_valid[cand].astype(jnp.int32), -1)
+        # done: the gang is whole (take, nothing left to scale up), or the
+        # attempt failed (failure is final — capacity only shrinks).
+        # Successful partial gangs re-enter the heap (re-push); conflict-
+        # rejected successes retry next chunk.
+        done_b = cand_valid & ((take & (total_cnt >= valid_cnt)) | ~succ_b)
+        fail_b = cand_valid & ~succ_b
+        # a scale-up failure of an already-quorate gang is not a fit
+        # failure of the gang (its quorum stands)
+        fail_fresh = fail_b & (placed_cnt == 0)
+        res = res.replace(
+            fit_reason=res.fit_reason.at[cand].set(
+                jnp.where(fail_fresh, 3,
+                          jnp.where(take, 0, res.fit_reason[cand]))),
+        )
+        # merge this attempt's new placements over prior attempts'
+        new_t = nodes_b >= 0                                      # [B, T]
         res = res.replace(
             free=free, device_free=dev, queue_allocated=qa,
             queue_allocated_nonpreemptible=qan,
             placements=res.placements.at[cand].set(
-                jnp.where(cand_valid[:, None], nodes_b,
-                          res.placements[cand])),
+                jnp.where(new_t, nodes_b, res.placements[cand])),
             placement_device=res.placement_device.at[cand].set(
-                jnp.where(cand_valid[:, None], devt_b,
-                          res.placement_device[cand])),
+                jnp.where(new_t, devt_b, res.placement_device[cand])),
             pipelined=res.pipelined.at[cand].set(
-                jnp.where(cand_valid[:, None], pipe_b,
-                          res.pipelined[cand])),
+                jnp.where(new_t, pipe_b, res.pipelined[cand])),
             allocated=res.allocated.at[cand].set(
-                res.allocated[cand] | take),
+                res.allocated[cand] | (take & (total_cnt >= need))),
             attempted=res.attempted.at[cand].set(
                 res.attempted[cand] | cand_valid),
         )
         remaining = remaining.at[cand].set(remaining[cand] & ~done_b)
-        return res, remaining, fuel - 1
+        if config.queue_depth is not None:
+            # retired lanes consume their queue's budget (conflict-
+            # rejected lanes re-attempt, so they count only once)
+            q_attempts = q_attempts + jax.ops.segment_sum(
+                done_b.astype(jnp.int32), g.queue[cand],
+                num_segments=q.q)
+            remaining = remaining & (
+                q_attempts[g.queue] < config.queue_depth)
+        if config.signature_skip:
+            # one quorum-attempt failure retires every equivalent gang —
+            # the signature groups (queue, task types, quorum,
+            # constraints).  Scale-up failures of quorate gangs don't
+            # poison the signature: equivalents may be at earlier stages.
+            failed_sig = failed_sig.at[g.sig[cand]].max(fail_fresh)
+            skip_now = remaining & failed_sig[g.sig]
+            res = res.replace(
+                fit_reason=jnp.where(skip_now, 2, res.fit_reason))
+            remaining = remaining & ~skip_now
+        return res, remaining, q_attempts, failed_sig, fuel - 1
 
-    # fuel: every chunk retires ≥1 remaining gang (the first remaining
-    # gang in order always lands in the accept prefix), so G chunks is a
-    # hard upper bound; the common case is ceil(G/B) + a few conflicts.
-    res, _, _ = lax.while_loop(cond, chunk, (init, remaining0,
-                                             jnp.asarray(G, jnp.int32)))
+    # fuel: every chunk either retires ≥1 remaining gang (the first
+    # remaining gang in order always lands in the accept prefix, or its
+    # exhausted queue drains from `remaining`) or places ≥1 new task of a
+    # re-pushed gang, so G*(T+1) chunks is a hard upper bound; the common
+    # case is ceil(G/B) + elastic re-pushes + a few conflicts.
+    res, _, _, _, _ = lax.while_loop(
+        cond, chunk,
+        (init, remaining0, jnp.zeros((q.q,), jnp.int32),
+         jnp.zeros((G,), bool), jnp.asarray(G * (T + 1), jnp.int32)))
     return res
 
 

@@ -103,30 +103,3 @@ def select_next_gang(
     """
     return job_order_perm(
         gangs, queues, queue_allocated, fair_share, total, remaining)[0]
-
-
-def static_job_order(
-    gangs: GangState,
-    queues: QueueState,
-    queue_allocated: jax.Array,
-    fair_share: jax.Array,
-    total: jax.Array,
-) -> jax.Array:
-    """One-shot permutation [G] — the cheap path that freezes the heap at
-    cycle start (queue keys do not react to this cycle's allocations).
-    Used when ``dynamic_order=False`` for large-G throughput.
-    """
-    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
-        queues, queue_allocated, fair_share, total)
-    qi = gangs.queue
-    below_min = gangs.running_count < gangs.min_member
-    return jnp.lexsort((
-        gangs.creation_order.astype(jnp.float32),
-        -gangs.priority.astype(jnp.float32),
-        (~below_min).astype(jnp.float32),
-        dom_share[qi],
-        neg_prio[qi],
-        over_quota[qi],
-        over_fs[qi],
-        (~gangs.valid).astype(jnp.float32),
-    ))

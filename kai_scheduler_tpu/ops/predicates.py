@@ -79,6 +79,22 @@ def node_portion(
     return p
 
 
+def _accel_pool_ok(
+    df: jax.Array,              # f32 [N, D]  the device pool to check
+    p: jax.Array,               # f32 [..., N] per-node fractional share
+    is_frac: jax.Array,         # bool [...]
+    req_accel: jax.Array,       # f32 [...]
+) -> jax.Array:
+    """Core device-pool check shared by :func:`accel_fit_mask` and the
+    allocator's fused :func:`feasible_nodes_dual`: a fractional task needs
+    ONE device with enough free share; a whole-device task needs enough
+    fully-free devices.  bool [..., N]."""
+    frac_ok = jnp.max(df, axis=-1) >= p - EPS                  # [..., N]
+    whole_free = jnp.sum((df >= 1.0 - EPS).astype(jnp.float32), axis=-1)
+    whole_ok = whole_free + EPS >= jnp.asarray(req_accel)[..., None]
+    return jnp.where(jnp.asarray(is_frac)[..., None], frac_ok, whole_ok)
+
+
 def accel_fit_mask(
     nodes: NodeState,
     task_req: jax.Array,        # f32 [..., R]
@@ -88,9 +104,7 @@ def accel_fit_mask(
     include_releasing: bool,
 ) -> jax.Array:
     """Device-granular accel feasibility — the ``FittingGPUs`` check
-    (``gpu_sharing/gpu_sharing.go``): a fractional task needs ONE device
-    with enough free share; a whole-device task needs enough fully-free
-    devices.  bool [..., N]."""
+    (``gpu_sharing/gpu_sharing.go``).  bool [..., N]."""
     df = device_free
     if include_releasing:
         df = df + nodes.device_releasing
@@ -103,10 +117,7 @@ def accel_fit_mask(
                else jnp.asarray(task_accel_mem))
         is_frac = (jnp.asarray(task_portion) > 0) | (mem > 0)
         p = node_portion(nodes, task_portion, task_accel_mem)  # [..., N]
-    frac_ok = jnp.max(df, axis=-1) >= p - EPS                  # [..., N]
-    whole_free = jnp.sum((df >= 1.0 - EPS).astype(jnp.float32), axis=-1)
-    whole_ok = whole_free + EPS >= req_accel[..., None]
-    return jnp.where(is_frac[..., None], frac_ok, whole_ok)
+    return _accel_pool_ok(df, p, is_frac, req_accel)
 
 
 def feasible_nodes(
@@ -116,6 +127,7 @@ def feasible_nodes(
     task_portion: jax.Array | None = None,
     task_accel_mem: jax.Array | None = None,
     *,
+    task_class: jax.Array | None = None,  # i32 [...] node-filter class
     free: jax.Array | None = None,
     device_free: jax.Array | None = None,
     include_releasing: bool = False,
@@ -146,7 +158,11 @@ def feasible_nodes(
     accel = accel_fit_mask(nodes, task_req, task_portion, task_accel_mem,
                            df, include_releasing)
     sel = selector_mask(nodes.labels, task_selector)
-    return fit & accel & sel & nodes.valid
+    out = fit & accel & sel & nodes.valid
+    if task_class is not None:
+        # taints/affinity/pod-affinity, host-evaluated per filter class
+        out = out & nodes.filter_masks[task_class]
+    return out
 
 
 def feasible_nodes_dual(
@@ -161,6 +177,7 @@ def feasible_nodes_dual(
     extra_releasing: jax.Array,        # f32 [N, R]
     extra_device_releasing: jax.Array, # f32 [N, D]
     devices: bool = True,
+    task_class: jax.Array | None = None,  # i32 [] node-filter class
 ) -> tuple[jax.Array, jax.Array]:
     """(fit_idle, fit_pipe) in one pass — the allocation kernel's hot
     check, sharing the selector/validity work between the idle pool and
@@ -174,6 +191,8 @@ def feasible_nodes_dual(
     is_frac = (portion > 0) | (mem > 0)
     req = jnp.asarray(task_req)
     sel = selector_mask(nodes.labels, task_selector) & nodes.valid     # [N]
+    if task_class is not None:
+        sel = sel & nodes.filter_masks[task_class]
 
     if not devices:
         fit_idle = jnp.all(free + EPS >= req[None, :], axis=-1) & sel
@@ -187,11 +206,8 @@ def feasible_nodes_dual(
     req_accel = req[RESOURCE_ACCEL]
 
     def pools(avail, df):
-        fit = jnp.all(avail + EPS >= req_nosum[None, :], axis=-1)
-        frac_ok = jnp.max(df, axis=-1) >= p - EPS
-        whole = jnp.sum((df >= 1.0 - EPS).astype(jnp.float32), axis=-1)
-        accel = jnp.where(is_frac, frac_ok, whole + EPS >= req_accel)
-        return fit & accel
+        return (resource_fit_mask(avail, req_nosum)
+                & _accel_pool_ok(df, p, is_frac, req_accel))
 
     fit_idle = pools(free, device_free) & sel
     fit_pipe = pools(

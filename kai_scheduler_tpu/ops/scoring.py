@@ -45,6 +45,12 @@ class PlacementConfig:
     #: gpupack vs gpuspread at the device granularity: pack puts fractions
     #: on the most-used fitting device, spread on the least-used
     device_pack: bool = True
+    #: the scoring plugin tiers (registry names, ordered; ref the default
+    #: plugin list in ``conf_util/scheduler_conf_util.go:40-60``) — a
+    #: config string reorders/disables plugins without code edits via
+    #: ``plugins.parse_tiers``
+    tiers: tuple[str, ...] = ("nodeplacement", "resourcetype",
+                              "nodeavailability")
 
 
 def pick_device(device_row: jax.Array,       # f32 [D] free share per device
@@ -171,15 +177,43 @@ def score_nodes_for_task(
     config: PlacementConfig = PlacementConfig(),
     extra: jax.Array | None = None,   # e.g. topology band, [..., N]
 ) -> jax.Array:
-    """The default scoring stack (resourcetype + availability + placement),
-    mirroring the default plugin tiers in ``conf_util/scheduler_conf_util.go``.
-    Returns f32 [..., N] with infeasible nodes at BIG_NEG.
+    """The configured scoring stack — ``config.tiers`` selects and orders
+    registered score plugins (default mirrors the reference's default
+    tiers, ``conf_util/scheduler_conf_util.go``).  Returns f32 [..., N]
+    with infeasible nodes at BIG_NEG.
     """
-    comps = [
-        placement_score(nodes, free, task_req, fit_pipeline, config),
-        resource_type_score(nodes, task_req),
-        availability_score(fit_idle),
-    ]
+    from ..plugins import ScoreContext, compose
+    ctx = ScoreContext(nodes=nodes, free=free, task_req=task_req,
+                       fit_idle=fit_idle, fit_pipe=fit_pipeline,
+                       placement=config)
+    comps = [compose(ctx, config.tiers)]
     if extra is not None:
         comps.append(extra)
     return compose_scores(fit_pipeline, *comps)
+
+
+# ---------------------------------------------------------------------------
+# Builtin plugin registrations (ref plugins/factory.go:47-75 entries that
+# score at node granularity; device-granularity and cross-attempt bands —
+# gpusharingorder, topology, nominatednode, k8s soft scores — are composed
+# by the allocation kernel as `extra` since they need per-attempt state)
+# ---------------------------------------------------------------------------
+
+def _register_builtins() -> None:
+    from ..plugins import register_score_plugin
+
+    @register_score_plugin("nodeplacement")
+    def _nodeplacement(ctx):
+        return placement_score(ctx.nodes, ctx.free, ctx.task_req,
+                               ctx.fit_pipe, ctx.placement)
+
+    @register_score_plugin("resourcetype")
+    def _resourcetype(ctx):
+        return resource_type_score(ctx.nodes, ctx.task_req)
+
+    @register_score_plugin("nodeavailability")
+    def _nodeavailability(ctx):
+        return availability_score(ctx.fit_idle)
+
+
+_register_builtins()

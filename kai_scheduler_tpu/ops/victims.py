@@ -381,7 +381,10 @@ def solve_for_preemptor(
             # consolidation victims are moved, not removed — their queue
             # allocation stays (allPodsReallocated validator below)
             qa_eff = qa if consolidate else qa - freed_queues
-            free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success = \
+            # victim search attempts gangs one at a time, so the wavefront
+            # bind-claim tensors (last two outputs) are not needed here
+            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
+             _, _) = \
                 _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
                               num_levels, alloc_cfg, extra_eff,
                               extra_dev_eff, chain=chain)
@@ -441,8 +444,8 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
     """Greedy re-placement of evicted consolidation victims — the
     ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
     scenario is valid only if *every* victim fits somewhere on the
-    post-preemptor state.  Resource-only feasibility (running pods carry
-    no selector in the snapshot); binpack by least free accel.  Moves may
+    post-preemptor state.  Feasibility = resources + the pod's node-filter
+    class (taints/affinity); binpack by least free accel.  Moves may
     draw on releasing capacity (including other victims' freed spots) —
     they are always pipelined rebinds, waiting for the old pods to vacate.
 
@@ -466,7 +469,8 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
             r.accel_held[m])                                   # [N]
         avail = free_l + releasing
         dev_avail = dev_l + device_releasing
-        fit = jnp.all(avail + EPS >= req[None, :], axis=-1) & n.valid
+        fit = (jnp.all(avail + EPS >= req[None, :], axis=-1) & n.valid
+               & n.filter_masks[r.filter_class[m]])
         frac_fit = jnp.max(dev_avail, axis=-1) >= p_n - EPS
         whole_free = jnp.sum((dev_avail >= 1.0 - EPS).astype(free_l.dtype),
                              axis=-1)

@@ -45,8 +45,13 @@ def state_shardings(state: ClusterState, mesh: Mesh, axis: str = NODE_AXIS):
     sharded over the mesh, everything else replicated."""
     shard = node_sharding(mesh, axis)
     repl = replicated(mesh)
-    return jax.tree.map(lambda _: repl, state).replace(
-        nodes=jax.tree.map(lambda _: shard, state.nodes))
+    node_shards = jax.tree.map(lambda _: shard, state.nodes)
+    # per-filter-class tables carry the node axis SECOND ([X, N]); shard
+    # that axis and replicate the (small, unpadded) class axis
+    class_by_node = NamedSharding(mesh, P(None, axis))
+    node_shards = node_shards.replace(
+        filter_masks=class_by_node, soft_scores=class_by_node)
+    return jax.tree.map(lambda _: repl, state).replace(nodes=node_shards)
 
 
 def shard_state(state: ClusterState, mesh: Mesh, axis: str = NODE_AXIS) -> ClusterState:

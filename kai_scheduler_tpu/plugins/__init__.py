@@ -1,0 +1,19 @@
+"""Score-plugin registry — composable score-tensor plugins.
+
+The reference composes 22 plugins through name→builder registries and
+configurable tiers (``plugins/factory.go:47-75``, tier/args config merged
+from a ConfigMap in ``conf_util/scheduler_conf_util.go:36-90``).  The TPU
+design promised the same shape with pure functions (SURVEY.md §7c): a
+scoring plugin is a pure ``ScoreContext -> [N] score band`` function, the
+configuration is a tuple of plugin names (string-selectable, orderable,
+disableable without code edits), and composition is a sum — each plugin
+already scales itself into its score band (``plugins/scores/scores.go``),
+so band priority is preserved under any ordering.
+"""
+from .registry import (ScoreContext, available_plugins, compose,
+                       parse_tiers, register_score_plugin, resolve)
+
+__all__ = [
+    "ScoreContext", "available_plugins", "compose", "parse_tiers",
+    "register_score_plugin", "resolve",
+]

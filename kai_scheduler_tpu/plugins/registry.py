@@ -1,0 +1,84 @@
+"""The score-plugin registry machinery.
+
+Plugins are pure functions over a :class:`ScoreContext`; registration
+mirrors ``framework.RegisterPluginBuilder`` (``plugins/factory.go``) and
+tier configuration mirrors the ConfigMap's plugin lists — a
+``tuple[str, ...]`` of names, resolvable from a comma-separated string.
+They run inside jit-traced kernels, so a tier tuple is part of the
+static kernel configuration: changing it recompiles, exactly like the
+reference restarting on ConfigMap change.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+
+
+@dataclasses.dataclass
+class ScoreContext:
+    """Everything a scoring plugin may consult for one task.
+
+    ``nodes`` is the snapshot's NodeState; ``free`` the live free tensor
+    at this point of the cycle; masks are the predicate outputs
+    (``fit_idle`` ⊆ ``fit_pipe``).  ``placement`` carries the
+    binpack/spread knobs (ref nodeplacement args).
+    """
+
+    nodes: object                 # NodeState
+    free: jax.Array               # f32 [N, R]
+    task_req: jax.Array           # f32 [R]
+    fit_idle: jax.Array           # bool [N]
+    fit_pipe: jax.Array           # bool [N]
+    placement: object             # scoring.PlacementConfig
+
+
+ScorePlugin = Callable[[ScoreContext], jax.Array]
+
+_SCORE_REGISTRY: dict[str, ScorePlugin] = {}
+
+
+def register_score_plugin(name: str):
+    """ref ``framework.RegisterPluginBuilder`` (``plugins/factory.go:47``)."""
+    def deco(fn: ScorePlugin) -> ScorePlugin:
+        _SCORE_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_plugins() -> list[str]:
+    _ensure_builtins()
+    return sorted(_SCORE_REGISTRY)
+
+
+def resolve(names: tuple[str, ...]) -> list[ScorePlugin]:
+    _ensure_builtins()
+    missing = [n for n in names if n not in _SCORE_REGISTRY]
+    if missing:
+        raise KeyError(
+            f"unknown score plugins {missing}; available: "
+            f"{available_plugins()}")
+    return [_SCORE_REGISTRY[n] for n in names]
+
+
+def parse_tiers(spec: str) -> tuple[str, ...]:
+    """Comma/whitespace-separated plugin list → tier tuple (the ConfigMap
+    string form, ref ``conf_util/scheduler_conf_util.go``)."""
+    return tuple(s for s in spec.replace(",", " ").split() if s)
+
+
+def compose(ctx: ScoreContext, names: tuple[str, ...]) -> jax.Array:
+    """Sum the selected plugins' bands — [N] f32 (no feasibility mask)."""
+    import jax.numpy as jnp
+    total = jnp.zeros_like(ctx.fit_pipe, dtype=jnp.float32)
+    for fn in resolve(names):
+        total = total + fn(ctx)
+    return total
+
+
+def _ensure_builtins() -> None:
+    """Builtin plugins live in ops.scoring; import lazily to avoid the
+    circular import (scoring uses this registry for composition)."""
+    if "nodeplacement" not in _SCORE_REGISTRY:
+        from ..ops import scoring  # noqa: F401  (registers on import)

@@ -315,4 +315,20 @@ class GrouperHub:
         )
         for pod in pods:
             pod.group = group.name
+        # attribute pods to declared subgroups (ref: the reference reads
+        # the pod's subgroup annotation, stamped by the workload
+        # operator; here pods without an explicit subgroup fill the
+        # declared subgroups' minMember slots in order)
+        if md.sub_groups:
+            untagged = [p for p in pods if not p.subgroup]
+            cursor = 0
+            for sg in md.sub_groups:
+                want = sg.min_member - sum(
+                    1 for p in pods if p.subgroup == sg.name)
+                for p in untagged[cursor:cursor + max(want, 0)]:
+                    p.subgroup = sg.name
+                cursor += max(want, 0)
+            # leftovers (elastic scale-up pods) join the last subgroup
+            for p in untagged[cursor:]:
+                p.subgroup = md.sub_groups[-1].name
         return group

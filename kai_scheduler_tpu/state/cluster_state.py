@@ -30,6 +30,7 @@ import numpy as np
 from flax import struct
 
 from ..apis import types as apis
+from . import node_filters
 
 UNLIMITED = apis.UNLIMITED
 R = apis.NUM_RESOURCES
@@ -58,6 +59,12 @@ class NodeState(struct.PyTreeNode):
     #: per-device memory GiB (ref MemoryOfEveryGpuOnNode) for memory-based
     #: share requests
     device_memory_gib: jax.Array  # f32 [N]
+    #: hard feasibility per (filter-class, node) — taints/tolerations,
+    #: affinity expressions, required pod-(anti-)affinity, evaluated
+    #: host-side per distinct pod spec (see ``state/node_filters.py``)
+    filter_masks: jax.Array      # bool [X, N]
+    #: soft bands per (filter-class, node), pre-weighted (K8sPlugins band)
+    soft_scores: jax.Array       # f32 [X, N]
 
     @property
     def n(self) -> int:
@@ -138,6 +145,49 @@ class GangState(struct.PyTreeNode):
     #: seconds the gang has been below minMember after starting; -1 = not
     #: stale (ref PodGroupInfo staleness + stalegangeviction action)
     stale_s: jax.Array          # f32 [G]
+    #: node-filter class per task (gather row into NodeState.filter_masks)
+    task_filter_class: jax.Array  # i32 [G, T]
+    #: task-type id per task — distinct (request, selector, portion,
+    #: memory, filter-class) tuples; powers the cheap whole-gang
+    #: feasibility prefilter (ref ``actions/common/feasible_nodes.go:11``)
+    task_type: jax.Array          # i32 [G, T]
+    #: scheduling-constraints signature per gang — equivalent gangs (same
+    #: queue, task-type multiset, quorum, topology constraints) share an
+    #: id, so one fit failure skips the rest for the cycle (ref
+    #: ``actions/common/minimal_job_comparison.go``,
+    #: ``podgroup_info`` schedulingConstraintsSignature)
+    sig: jax.Array                # i32 [G]
+    #: the task-type table (Y distinct types, padded)
+    type_req: jax.Array           # f32 [Y, R]
+    type_selector: jax.Array      # i32 [Y, K]
+    type_portion: jax.Array       # f32 [Y]
+    type_mem: jax.Array           # f32 [Y]
+    type_class: jax.Array         # i32 [Y]
+    # --- hierarchical subgroups (ref podgroup_types.go SubGroups +
+    # subgroup_info PodSet tree; allocation semantics in
+    # actions/common/allocate.go:71-140 allocateSubGroupSet).  Slot 0 is
+    # the implicit default subgroup; gangs without declared subgroups put
+    # every task there with the gang's own minMember.
+    #: subgroup slot per task
+    task_subgroup: jax.Array        # i32 [G, T]
+    subgroup_valid: jax.Array       # bool [G, S]
+    subgroup_min_member: jax.Array  # i32 [G, S]
+    #: minMember minus the subgroup's bound/running pods — new placements
+    #: needed for the subgroup's quorum this cycle
+    subgroup_min_needed: jax.Array  # i32 [G, S]
+    #: per-subgroup required topology level (-1 = none): every task of
+    #: the subgroup must land in ONE domain at this level, independently
+    #: chosen per subgroup
+    subgroup_required_level: jax.Array  # i32 [G, S]
+
+    @property
+    def s(self) -> int:
+        return self.subgroup_valid.shape[1]
+    #: nominated node index per task, -1 = none (nominatednode plugin)
+    task_nominated: jax.Array     # i32 [G, T]
+    #: gang-internal anti-affinity: tasks of this gang may not share a
+    #: topology domain at this level (L = per-node, -1 = none)
+    anti_self_level: jax.Array    # i32 [G]
 
     @property
     def g(self) -> int:
@@ -175,6 +225,9 @@ class RunningState(struct.PyTreeNode):
     #: memory-based request GiB (0 = not memory-based) — consolidation
     #: re-placement must recompute the portion for the *target* node
     accel_mem: jax.Array     # f32 [M]
+    #: node-filter class (consolidation moves must respect the pod's
+    #: taints/affinity constraints on the target node)
+    filter_class: jax.Array  # i32 [M]
 
     @property
     def m(self) -> int:
@@ -228,6 +281,15 @@ class SnapshotIndex:
     selector_keys: list[str]
     label_vocab: dict[tuple[str, str], int]
     topology_levels: list[str]
+    #: snapshot-derived kernel-config hints (see AllocateConfig): whether
+    #: any fractional/memory-based accel request exists (device table
+    #: needed), whether every gang's pending tasks are identical replicas
+    #: (whole-gang fast path valid), and whether any gang carries a
+    #: required topology level (domain loop needed)
+    needs_device_table: bool = True
+    uniform_gangs: bool = False
+    has_required_topology: bool = True
+    has_subgroup_topology: bool = True
 
     def node_index(self, name: str) -> int:
         return self.node_names.index(name)
@@ -244,6 +306,7 @@ def build_snapshot(
     pad: int = 8,
     dtype=jnp.float32,
     now: float | None = None,
+    queue_usage: dict[str, "np.ndarray"] | None = None,
 ) -> tuple[ClusterState, SnapshotIndex]:
     """Flatten API objects into a ClusterState (+ index for the commit path).
 
@@ -385,7 +448,38 @@ def build_snapshot(
         running_count=np.zeros((G,), np.int32),
         min_needed=np.zeros((G,), np.int32),
         stale_s=np.full((G,), -1.0, np.float32),
+        task_filter_class=np.zeros((G, T), np.int32),
+        task_nominated=np.full((G, T), -1, np.int32),
+        anti_self_level=np.full((G,), -1, np.int32),
+        task_type=np.zeros((G, T), np.int32),
+        sig=np.zeros((G,), np.int32),
     )
+    # --- subgroup tables (slot 0 = implicit default subgroup, so the
+    # slot count is max declared subgroups + 1) ----------------------------
+    S = _round_up(max([len(g.sub_groups) for g in pod_groups] + [0]) + 1, 4)
+    gk["task_subgroup"] = np.zeros((G, T), np.int32)
+    gk["subgroup_valid"] = np.zeros((G, S), bool)
+    gk["subgroup_min_member"] = np.zeros((G, S), np.int32)
+    gk["subgroup_min_needed"] = np.zeros((G, S), np.int32)
+    gk["subgroup_required_level"] = np.full((G, S), -1, np.int32)
+    sub_slot: list[dict[str, int]] = [{} for _ in range(G)]
+    sub_running = np.zeros((G, S), np.int32)
+    # --- node-filter classes: dedupe pod specs ---------------------------
+    filter_specs: list[tuple] = [node_filters.EMPTY_SPEC]
+    spec_index: dict[tuple, int] = {node_filters.EMPTY_SPEC: 0}
+    spec_pods: dict[tuple, apis.Pod] = {
+        node_filters.EMPTY_SPEC: apis.Pod("", "")}
+
+    def filter_class_of(pod: apis.Pod) -> int:
+        key = node_filters.pod_filter_spec(pod)
+        if key not in spec_index:
+            spec_index[key] = len(filter_specs)
+            filter_specs.append(key)
+            spec_pods[key] = pod
+        return spec_index[key]
+
+    node_idx0 = {name: i for i, name in enumerate(node_names)}
+    task_type_index: dict[tuple, int] = {}
     task_names: list[list[str | None]] = [[None] * T for _ in range(G)]
     for i, g in enumerate(pod_groups):
         tasks = pending_by_group[g.name]
@@ -397,7 +491,23 @@ def build_snapshot(
         gk["preemptible"][i] = g.preemptibility == apis.Preemptibility.PREEMPTIBLE
         gk["valid"][i] = bool(tasks)
         gk["creation_order"][i] = i
-        gk["backoff"][i] = g.scheduling_backoff
+        # the UnschedulableOnNodePool condition keeps the gang out of the
+        # cycle until cleared (ref cluster_info skipping marked groups)
+        gk["backoff"][i] = 1 if g.unschedulable else 0
+        # declared subgroups take slots 1.. ; slot 0 is the default
+        # subgroup (all tasks of a plain gang, quorum = gang minMember)
+        for si, sg in enumerate(g.sub_groups[:S - 1], start=1):
+            sub_slot[i][sg.name] = si
+            gk["subgroup_valid"][i, si] = True
+            gk["subgroup_min_member"][i, si] = sg.min_member
+            tc_sg = sg.topology_constraint
+            if (tc_sg is not None and topology is not None
+                    and tc_sg.required_level in topo_levels):
+                gk["subgroup_required_level"][i, si] = \
+                    topo_levels.index(tc_sg.required_level)
+        gk["subgroup_valid"][i, 0] = True
+        gk["subgroup_min_member"][i, 0] = \
+            0 if g.sub_groups else g.min_member
         tc = g.topology_constraint
         if tc is not None and topology is not None:
             if tc.required_level in topo_levels:
@@ -417,10 +527,25 @@ def build_snapshot(
             gk["task_valid"][i, t] = True
             gk["task_portion"][i, t] = pod.accel_portion
             gk["task_accel_mem"][i, t] = pod.accel_memory_gib
+            gk["task_filter_class"][i, t] = filter_class_of(pod)
+            gk["task_subgroup"][i, t] = sub_slot[i].get(pod.subgroup or "", 0)
+            if pod.nominated_node is not None:
+                gk["task_nominated"][i, t] = node_idx0.get(
+                    pod.nominated_node, -1)
+            asl = node_filters.anti_self_level(pod, topo_levels, L)
+            if asl >= 0:
+                cur = gk["anti_self_level"][i]
+                gk["anti_self_level"][i] = asl if cur < 0 else min(cur, asl)
             task_names[i][t] = pod.name
             for ki, key in enumerate(selector_keys):
                 if key in pod.node_selector:
                     gk["task_selector"][i, t, ki] = value_id(key, pod.node_selector[key])
+            tkey = (gk["task_req"][i, t].tobytes(),
+                    gk["task_selector"][i, t].tobytes(),
+                    float(pod.accel_portion), float(pod.accel_memory_gib),
+                    int(gk["task_filter_class"][i, t]))
+            gk["task_type"][i, t] = task_type_index.setdefault(
+                tkey, len(task_type_index))
 
     # --- running pods -----------------------------------------------------
     # Pods whose node is missing from the snapshot (cordoned/deleted) keep
@@ -442,6 +567,7 @@ def build_snapshot(
         devices_mask=np.zeros((M,), np.int32),
         accel_held=np.zeros((M,), np.float32),
         accel_mem=np.zeros((M,), np.float32),
+        filter_class=np.zeros((M,), np.int32),
     )
     running_names: list[str] = [""] * M
     if now is None:
@@ -451,6 +577,7 @@ def build_snapshot(
         rk["req"][j] = pod.resources.as_tuple()
         rk["node"][j] = node_idx.get(pod.node, -1)
         rk["accel_mem"][j] = pod.accel_memory_gib
+        rk["filter_class"][j] = filter_class_of(pod)
         if pod.accel_portion > 0:
             rk["req"][j, 0] = pod.accel_portion
         elif pod.accel_memory_gib > 0:
@@ -508,10 +635,42 @@ def build_snapshot(
         running_names[j] = pod.name
         if grp >= 0 and pod.status != apis.PodStatus.RELEASING:
             gk["running_count"][grp] += 1
+            sub_running[grp, sub_slot[grp].get(pod.subgroup or "", 0)] += 1
     for i, grp_obj in enumerate(pod_groups):
         if grp_obj.stale_since is not None:
             gk["stale_s"][i] = max(0.0, now - grp_obj.stale_since)
     gk["min_needed"] = np.maximum(gk["min_member"] - gk["running_count"], 0)
+    gk["subgroup_min_needed"] = np.maximum(
+        gk["subgroup_min_member"] - sub_running, 0)
+
+    # --- task-type table + scheduling signatures --------------------------
+    Y = _round_up(max(len(task_type_index), 1), 4)
+    gk["type_req"] = np.zeros((Y, R), np.float32)
+    gk["type_selector"] = np.full((Y, K), -1, np.int32)
+    gk["type_portion"] = np.zeros((Y,), np.float32)
+    gk["type_mem"] = np.zeros((Y,), np.float32)
+    gk["type_class"] = np.zeros((Y,), np.int32)
+    for (req_b, sel_b, portion, mem, fclass), tid in task_type_index.items():
+        gk["type_req"][tid] = np.frombuffer(req_b, np.float32)
+        gk["type_selector"][tid] = np.frombuffer(sel_b, np.int32)
+        gk["type_portion"][tid] = portion
+        gk["type_mem"][tid] = mem
+        gk["type_class"][tid] = fclass
+    sig_index: dict[tuple, int] = {}
+    for i in range(len(pod_groups)):
+        if not gk["valid"][i]:
+            continue
+        tids = tuple(sorted(
+            (int(gk["task_type"][i, t]), int(gk["task_subgroup"][i, t]))
+            for t in range(T) if gk["task_valid"][i, t]))
+        subs = tuple(
+            (int(gk["subgroup_min_needed"][i, s]),
+             int(gk["subgroup_required_level"][i, s]))
+            for s in range(S) if gk["subgroup_valid"][i, s])
+        skey = (int(gk["queue"][i]), tids, subs, int(gk["min_needed"][i]),
+                int(gk["required_level"][i]), int(gk["preferred_level"][i]),
+                int(gk["anti_self_level"][i]), bool(gk["preemptible"][i]))
+        gk["sig"][i] = sig_index.setdefault(skey, len(sig_index))
 
     # --- derived node free / releasing -----------------------------------
     node_used = np.zeros((N, R), np.float32)
@@ -542,12 +701,53 @@ def build_snapshot(
         if gk["valid"][i]:
             qi = gk["queue"][i]
             q_request[qi] += gk["task_req"][i][gk["task_valid"][i]].sum(axis=0)
+    # historical usage (usagedb feed), normalized usage/clusterCapacity —
+    # the k_value term of the DRF waterfill (ref usagedb.go:20-60)
+    q_usage = np.zeros((Q, R), np.float32)
+    if queue_usage:
+        for qname, vec in queue_usage.items():
+            qi2 = q_index.get(qname)
+            if qi2 is not None:
+                q_usage[qi2] = np.asarray(vec, np.float32)
     # propagate to parents (requests/allocations roll up the hierarchy)
-    for arr in (q_alloc, q_alloc_np, q_request):
+    for arr in (q_alloc, q_alloc_np, q_request, q_usage):
         for i in sorted(range(len(queues)), key=lambda i: -q_depth[i]):
             p = q_parent[i]
             if p >= 0:
                 arr[p] += arr[i]
+
+    # --- evaluate filter classes against nodes (host, once per spec) ------
+    running_views = [
+        node_filters._RunningPodView(labels=pod.labels,
+                                     node=int(rk["node"][j]))
+        for j, pod in enumerate(running_pods)
+        if pod.status != apis.PodStatus.RELEASING]
+    filter_masks, soft_scores = node_filters.evaluate_filter_classes(
+        filter_specs, spec_pods, live_nodes, node_topo, topo_levels,
+        running_views, N)
+
+    # --- kernel-config hints derived from the snapshot shape --------------
+    has_fracs = bool(gk["task_portion"].any() or gk["task_accel_mem"].any()
+                     or (rk["device"] >= 0).any())
+    tvm = gk["task_valid"][:, :, None]
+    uniform = (
+        not has_fracs
+        and not any(g.sub_groups for g in pod_groups)
+        and bool((gk["task_nominated"] < 0).all())
+        # per-node anti-self is supported by the whole-gang kernel (one
+        # replica per node); coarser levels need the per-task path
+        and bool(((gk["anti_self_level"] == -1)
+                  | (gk["anti_self_level"] == L)).all())
+        # padded task rows are zero — compare valid rows against task 0
+        and bool((np.where(tvm, gk["task_req"],
+                           gk["task_req"][:, :1]) ==
+                  gk["task_req"][:, :1]).all())
+        and bool((np.where(tvm, gk["task_selector"],
+                           gk["task_selector"][:, :1]) ==
+                  gk["task_selector"][:, :1]).all())
+        and bool((np.where(gk["task_valid"], gk["task_filter_class"],
+                           gk["task_filter_class"][:, :1]) ==
+                  gk["task_filter_class"][:, :1]).all()))
 
     state = ClusterState(
         nodes=NodeState(
@@ -560,6 +760,8 @@ def build_snapshot(
             device_free=jnp.asarray(dev_free, dtype),
             device_releasing=jnp.asarray(dev_rel, dtype),
             device_memory_gib=jnp.asarray(node_dev_mem, dtype),
+            filter_masks=jnp.asarray(filter_masks),
+            soft_scores=jnp.asarray(soft_scores, dtype),
         ),
         queues=QueueState(
             parent=jnp.asarray(q_parent),
@@ -571,7 +773,7 @@ def build_snapshot(
             allocated=jnp.asarray(q_alloc, dtype),
             allocated_nonpreemptible=jnp.asarray(q_alloc_np, dtype),
             request=jnp.asarray(q_request, dtype),
-            usage=jnp.zeros((Q, R), dtype),
+            usage=jnp.asarray(q_usage, dtype),
             fair_share=jnp.zeros((Q, R), dtype),
             valid=jnp.asarray(q_valid),
             creation_order=jnp.asarray(q_creation),
@@ -590,5 +792,10 @@ def build_snapshot(
         selector_keys=selector_keys,
         label_vocab=label_vocab,
         topology_levels=topo_levels,
+        needs_device_table=has_fracs,
+        uniform_gangs=uniform,
+        has_required_topology=bool((gk["required_level"] >= 0).any()),
+        has_subgroup_topology=bool(
+            (gk["subgroup_required_level"] >= 0).any()),
     )
     return state, index

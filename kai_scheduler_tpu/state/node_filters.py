@@ -1,0 +1,166 @@
+"""Node-filter classes: the wide predicate surface, host-evaluated.
+
+The reference filters each (pod, node) pair through the upstream
+kube-scheduler plugins — TaintToleration, NodeAffinity, InterPodAffinity
+(``k8s_internal/predicates/predicates.go:70-140``) — an irregular,
+string-matching computation that has no good dense-tensor form.  The
+TPU-native design exploits the same redundancy the reference's
+scheduling-signature skip list does (``actions/common/
+minimal_job_comparison.go``): pods overwhelmingly share identical filter
+specs (one pod template per gang), so the *distinct* specs form a small
+vocabulary.  At snapshot build each distinct spec is evaluated against
+every node ONCE on the host, yielding
+
+- ``filter_masks``  bool [X, N] — hard feasibility per (spec, node)
+- ``soft_scores``   f32  [X, N] — the soft bands (PreferNoSchedule taint
+  penalty + preferred pod-affinity), pre-weighted into the K8sPlugins
+  score band (``plugins/scores/scores.go`` K8sPlugins = 1e5)
+
+and every task carries its spec's class id.  The device kernels then pay
+ONE gather per task instead of re-running string matches per node —
+irregular logic runs once per distinct spec, regular lookup runs on the
+accelerator.
+
+Class 0 is always the empty spec (no tolerations, no affinity): its mask
+still excludes nodes with untolerated hard taints, which is what keeps
+plain pods off control-plane/maintenance nodes.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..apis import types as apis
+
+#: score-band ceiling and weight (ref plugins/scores/scores.go)
+_MAX_BAND = 9.0
+_W_K8S = 100_000.0
+
+_HARD_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def pod_filter_spec(pod: apis.Pod) -> tuple:
+    """Canonical hashable key of a pod's node-filter spec."""
+    aff = tuple(sorted(
+        (e.key, e.operator, tuple(e.values)) for e in pod.node_affinity))
+    tol = tuple(sorted(
+        (t.key or "", t.operator, t.value, t.effect or "")
+        for t in pod.tolerations))
+    pa = tuple(sorted(
+        (term.match_labels, term.topology_key, term.anti, term.required)
+        for term in pod.pod_affinity))
+    return (aff, tol, pa)
+
+
+EMPTY_SPEC = ((), (), ())
+
+
+@dataclasses.dataclass
+class _RunningPodView:
+    """What pod-affinity terms need to know about existing pods."""
+
+    labels: dict[str, str]
+    node: int  # snapshot node index, -1 unknown
+
+
+def _domain_ids(node_topo: np.ndarray, topo_levels: list[str],
+                topology_key: str, num_nodes: int) -> np.ndarray:
+    """i32 [N]: the domain each node belongs to at ``topology_key``'s
+    level; unknown keys mean per-node (hostname) granularity."""
+    if topology_key in topo_levels:
+        return node_topo[:num_nodes, topo_levels.index(topology_key)]
+    return np.arange(num_nodes, dtype=np.int32)
+
+
+def evaluate_filter_classes(
+    specs: list[tuple],
+    pods_by_spec: dict[tuple, apis.Pod],
+    live_nodes: list[apis.Node],
+    node_topo: np.ndarray,          # i32 [N_padded, L]
+    topo_levels: list[str],
+    running: list[_RunningPodView],
+    num_nodes_padded: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Evaluate every distinct spec against every node.
+
+    Returns (filter_masks bool [X, N_padded], soft_scores f32
+    [X, N_padded]); padded node slots are masked False.
+    """
+    X = len(specs)
+    N = len(live_nodes)
+    masks = np.zeros((X, num_nodes_padded), bool)
+    soft = np.zeros((X, num_nodes_padded), np.float32)
+
+    for xi, spec in enumerate(specs):
+        pod = pods_by_spec[spec]
+        mask = np.ones((N,), bool)
+        prefer_penalty = np.zeros((N,), np.float32)
+        # --- taints vs tolerations (upstream TaintToleration) ------------
+        for ni, node in enumerate(live_nodes):
+            for taint in node.taints:
+                tolerated = any(t.tolerates(taint) for t in pod.tolerations)
+                if tolerated:
+                    continue
+                if taint.effect in _HARD_EFFECTS:
+                    mask[ni] = False
+                elif taint.effect == "PreferNoSchedule":
+                    prefer_penalty[ni] += 1.0
+        # --- node affinity expressions (upstream NodeAffinity) -----------
+        if pod.node_affinity:
+            for ni, node in enumerate(live_nodes):
+                if mask[ni] and not all(
+                        e.matches(node.labels) for e in pod.node_affinity):
+                    mask[ni] = False
+        # --- inter-pod (anti-)affinity (upstream InterPodAffinity) -------
+        pref_aff = np.zeros((N,), np.float32)
+        for term_key in spec[2]:
+            match_labels, topology_key, anti, required = term_key
+            term = apis.PodAffinityTerm(
+                match_labels=match_labels, topology_key=topology_key,
+                anti=anti, required=required)
+            doms = _domain_ids(node_topo, topo_levels, topology_key, N)
+            dmax = int(doms.max(initial=-1)) + 1
+            counts = np.zeros((max(dmax, 1),), np.int64)
+            for rp in running:
+                if rp.node >= 0 and rp.node < N and term.selects(rp.labels):
+                    d = doms[rp.node]
+                    if d >= 0:
+                        counts[d] += 1
+            node_counts = np.where(doms >= 0, counts[np.maximum(doms, 0)], 0)
+            if required:
+                mask &= (node_counts == 0) if anti else (node_counts > 0)
+            else:
+                pref_aff += (-node_counts if anti
+                             else node_counts).astype(np.float32)
+        # --- soft bands, normalized into [0, MAX_BAND] --------------------
+        band = np.zeros((N,), np.float32)
+        pmax = prefer_penalty.max(initial=0.0)
+        if pmax > 0:  # fewer untolerated PreferNoSchedule taints = better
+            band += _MAX_BAND * (pmax - prefer_penalty) / pmax
+        lo, hi = pref_aff.min(initial=0.0), pref_aff.max(initial=0.0)
+        if hi > lo:  # more preferred-affinity matches = better
+            band += _MAX_BAND * (pref_aff - lo) / (hi - lo)
+        masks[xi, :N] = mask
+        soft[xi, :N] = np.clip(band, 0.0, _MAX_BAND) * _W_K8S
+    return masks, soft
+
+
+def anti_self_level(pod: apis.Pod, topo_levels: list[str],
+                    num_levels: int) -> int:
+    """The gang-internal spread constraint: a required anti-affinity term
+    whose selector matches the pod's OWN labels forbids two pods of the
+    gang sharing a domain.  Returns the topology level index, ``L`` (the
+    level count) for per-node granularity, or -1 for none.  When several
+    such terms exist the coarsest (outermost) level wins.
+    """
+    best = -1
+    for term in pod.pod_affinity:
+        if not (term.required and term.anti and term.selects(pod.labels)):
+            continue
+        if term.topology_key in topo_levels:
+            lvl = topo_levels.index(term.topology_key)
+        else:
+            lvl = num_levels  # per-node
+        best = lvl if best < 0 else min(best, lvl)
+    return best

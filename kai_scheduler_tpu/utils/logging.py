@@ -1,0 +1,67 @@
+"""Leveled, scoped logging — ref ``pkg/scheduler/log/log.go`` InfraLogger.
+
+The reference uses a zap logger with numeric verbosity (``V(n)``) and
+stamps every line with the session/action scope
+(``scheduler.go:130-131``).  Same surface over stdlib logging: verbosity
+gates at call time, scopes compose via ``with_scope``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class InfraLogger:
+    """``logger.V(3).infof(...)`` — zap-style verbosity levels."""
+
+    def __init__(self, name: str = "kai", verbosity: int | None = None,
+                 scope: str = ""):
+        self._logger = logging.getLogger(name)
+        if not self._logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s %(message)s"))
+            self._logger.addHandler(handler)
+            self._logger.setLevel(logging.INFO)
+        if verbosity is None:
+            verbosity = int(os.environ.get("KAI_LOG_V", "2"))
+        self.verbosity = verbosity
+        self.scope = scope
+
+    def with_scope(self, **kv: object) -> "InfraLogger":
+        """A child logger stamping e.g. session/action ids on every line."""
+        scope = " ".join(f"{k}={v}" for k, v in kv.items())
+        child = InfraLogger.__new__(InfraLogger)
+        child._logger = self._logger
+        child.verbosity = self.verbosity
+        child.scope = f"{self.scope} {scope}".strip()
+        return child
+
+    class _V:
+        def __init__(self, parent: "InfraLogger", enabled: bool):
+            self._parent = parent
+            self._enabled = enabled
+
+        def infof(self, fmt: str, *args: object) -> None:
+            if self._enabled:
+                self._parent._emit(logging.INFO, fmt, args)
+
+        def warnf(self, fmt: str, *args: object) -> None:
+            if self._enabled:
+                self._parent._emit(logging.WARNING, fmt, args)
+
+    def V(self, level: int) -> "_V":  # noqa: N802 — zap-style name
+        return InfraLogger._V(self, level <= self.verbosity)
+
+    def errorf(self, fmt: str, *args: object) -> None:
+        self._emit(logging.ERROR, fmt, args)
+
+    def _emit(self, level: int, fmt: str, args: tuple) -> None:
+        msg = fmt % args if args else fmt
+        if self.scope:
+            msg = f"[{self.scope}] {msg}"
+        self._logger.log(level, msg)
+
+
+logger = InfraLogger()

@@ -1,0 +1,150 @@
+"""Minimal Prometheus-style metrics registry (no external deps).
+
+The reference exports its scheduler metrics through prometheus client_go
+(``pkg/scheduler/metrics/metrics.go:39-58``; catalog in
+``docs/metrics/METRICS.md``).  This module provides the same shapes —
+Counter / Gauge / Histogram with label vectors — plus a text exposition
+renderer, so a sidecar can serve ``/metrics`` verbatim.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class _Metric:
+    name: str
+    help: str
+    label_names: tuple[str, ...] = ()
+
+    def _key(self, labels: tuple[str, ...]) -> tuple[str, ...]:
+        if len(labels) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {labels}")
+        return labels
+
+
+class Counter(_Metric):
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *labels: str) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        return _render_simple(self, "counter", self._values)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, *labels: str, value: float) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def value(self, *labels: str) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        return _render_simple(self, "gauge", self._values)
+
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help="", label_names=(),
+                 buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help, tuple(label_names))
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, *labels: str, value: float) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1))
+            counts[bisect.bisect_left(self.buckets, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def count(self, *labels: str) -> int:
+        return sum(self._counts.get(self._key(labels), []))
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for key, counts in sorted(self._counts.items()):
+            cum = 0
+            for le, c in zip(self.buckets, counts):
+                cum += c
+                lines.append(
+                    f"{self.name}_bucket{_labels(self, key, le=le)} {cum}")
+            cum += counts[-1]
+            lines.append(
+                f'{self.name}_bucket{_labels(self, key, le="+Inf")} {cum}')
+            lines.append(f"{self.name}_sum{_labels(self, key)} "
+                         f"{self._sums[key]}")
+            lines.append(f"{self.name}_count{_labels(self, key)} {cum}")
+        return lines
+
+
+def _labels(metric: _Metric, key: tuple[str, ...], **extra) -> str:
+    pairs = list(zip(metric.label_names, key)) + [
+        (k, v) for k, v in extra.items()]
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def _render_simple(metric: _Metric, kind: str, values: dict) -> list[str]:
+    lines = [f"# HELP {metric.name} {metric.help}",
+             f"# TYPE {metric.name} {kind}"]
+    for key, v in sorted(values.items()):
+        lines.append(f"{metric.name}{_labels(metric, key)} {v}")
+    return lines
+
+
+class Registry:
+    """A metric collection with text exposition."""
+
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+
+    def counter(self, name, help="", label_names=()) -> Counter:
+        m = Counter(name, help, label_names)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, name, help="", label_names=()) -> Gauge:
+        m = Gauge(name, help, label_names)
+        self._metrics.append(m)
+        return m
+
+    def histogram(self, name, help="", label_names=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help, label_names, buckets)
+        self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"

@@ -22,3 +22,11 @@ _ensure_cpu_device_count(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite's cost is dominated by jit
+# compiles of the solver kernels (heavy nested control flow), most of
+# which recur across tests, xdist workers, and runs.  The cache is
+# content-addressed, so stale entries are never wrongly reused.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

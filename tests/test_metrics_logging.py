@@ -1,0 +1,56 @@
+"""Metrics registry + leveled logging tests (ref
+``pkg/scheduler/metrics/metrics.go``, ``pkg/scheduler/log/log.go``)."""
+from kai_scheduler_tpu.utils.logging import InfraLogger
+from kai_scheduler_tpu.utils.metrics import Registry
+
+
+def test_counter_gauge_histogram_and_exposition():
+    reg = Registry()
+    c = reg.counter("kai_podgroups_scheduled_total", "x", ("action",))
+    g = reg.gauge("kai_queue_fair_share", "y", ("queue", "resource"))
+    h = reg.histogram("kai_e2e_scheduling_latency_seconds", "z",
+                      buckets=(0.01, 0.1, 1.0))
+    c.inc("allocate")
+    c.inc("allocate", by=2)
+    g.set("team-a", "accel", value=4.5)
+    h.observe(value=0.05)
+    h.observe(value=5.0)
+    assert c.value("allocate") == 3
+    assert g.value("team-a", "accel") == 4.5
+    assert h.count() == 2
+    text = reg.render()
+    assert 'kai_podgroups_scheduled_total{action="allocate"} 3' in text
+    assert 'kai_queue_fair_share{queue="team-a",resource="accel"} 4.5' in text
+    assert 'kai_e2e_scheduling_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'kai_e2e_scheduling_latency_seconds_bucket{le="+Inf"} 2' in text
+    assert "# TYPE kai_queue_fair_share gauge" in text
+
+
+def test_scheduler_cycle_populates_metrics():
+    from kai_scheduler_tpu.apis import types as apis
+    from kai_scheduler_tpu.framework import metrics
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=8))]
+    groups = [apis.PodGroup("g", queue="q", min_member=1)]
+    pods = [apis.Pod("p", "g", apis.ResourceVec(1, 1, 1))]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    before = metrics.podgroups_scheduled.value("all")
+    Scheduler().run_once(cluster)
+    assert metrics.podgroups_scheduled.value("all") >= before + 1
+    assert metrics.queue_fair_share.value("q", "accel") > 0
+    assert metrics.e2e_latency.count() >= 1
+    assert "kai_queue_fair_share" in metrics.registry.render()
+
+
+def test_infra_logger_verbosity_and_scope(capsys):
+    log = InfraLogger(name="kai-test", verbosity=3)
+    scoped = log.with_scope(session=7, action="allocate")
+    scoped.V(2).infof("placed %d pods", 5)
+    scoped.V(5).infof("should not appear")
+    err = capsys.readouterr().err
+    assert "placed 5 pods" in err
+    assert "session=7" in err and "action=allocate" in err
+    assert "should not appear" not in err

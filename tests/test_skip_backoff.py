@@ -1,0 +1,137 @@
+"""Skip machinery tests: feasibility prefilter, scheduling-signature
+skip, unschedulable marking + backoff — the analogue of
+``actions/common/feasible_nodes.go`` / ``minimal_job_comparison.go`` and
+the status_updater's UnschedulableOnNodePool flow."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.state import build_snapshot
+
+
+def run_allocate(state, *, num_levels=1, **cfg):
+    fs = drf.set_fair_share(state, num_levels=num_levels)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    return allocate(state, fs, num_levels=num_levels,
+                    config=AllocateConfig(**cfg))
+
+
+def _setup(n_accel=2.0, gang_reqs=((2.0,),)):
+    nodes = [apis.Node("n0", apis.ResourceVec(n_accel, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups, pods = [], []
+    for gi, reqs in enumerate(gang_reqs):
+        groups.append(apis.PodGroup(f"g{gi}", queue="q",
+                                    min_member=len(reqs)))
+        for ti, a in enumerate(reqs):
+            pods.append(apis.Pod(f"p{gi}-{ti}", f"g{gi}",
+                                 apis.ResourceVec(a, 1, 1)))
+    return nodes, queues, groups, pods
+
+
+def test_prefilter_drops_hopeless_gang_without_attempt():
+    """A gang whose task fits no node is never attempted (reason 1)."""
+    nodes, queues, groups, pods = _setup(
+        n_accel=2.0, gang_reqs=((1.0,), (16.0,)))
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    assert not np.asarray(res.attempted)[1]
+    assert int(np.asarray(res.fit_reason)[1]) == 1
+
+
+def test_prefilter_respects_min_needed_quorum():
+    """Elastic gang: 3 tasks, min_member=2, only 2 can ever fit — the
+    prefilter must NOT drop it (it counts feasible tasks vs min_needed)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups = [apis.PodGroup("g", queue="q", min_member=2)]
+    pods = [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1))
+            for i in range(3)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    assert int((np.asarray(res.placements)[0] >= 0).sum()) == 2
+
+
+def test_signature_skip_after_equivalent_failure():
+    """Three identical single-task gangs on a 2-accel node: the first
+    fills the node, the second fails the attempt, the third is skipped
+    as an equivalent (reason 2, not attempted)."""
+    nodes, queues, groups, pods = _setup(
+        n_accel=2.0, gang_reqs=((2.0,), (2.0,), (2.0,)))
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, batch_size=1)
+    allocated = np.asarray(res.allocated)
+    attempted = np.asarray(res.attempted)
+    reasons = np.asarray(res.fit_reason)
+    assert allocated[0] and not allocated[1] and not allocated[2]
+    assert attempted[1]
+    assert int(reasons[1]) == 3
+    assert not attempted[2]
+    assert int(reasons[2]) == 2
+
+
+def test_signature_differs_across_queues():
+    """Equivalence includes the queue: a failure in one queue must not
+    skip an identical gang in another (their capacity gates differ)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(4, 64, 256))]
+    queues = [
+        apis.Queue("qa", accel=apis.QueueResource(quota=0.0, limit=0.0)),
+        apis.Queue("qb", accel=apis.QueueResource(quota=4.0)),
+    ]
+    groups = [apis.PodGroup("ga", queue="qa", min_member=1),
+              apis.PodGroup("gb", queue="qb", min_member=1)]
+    pods = [apis.Pod("pa", "ga", apis.ResourceVec(2, 1, 1)),
+            apis.Pod("pb", "gb", apis.ResourceVec(2, 1, 1))]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    res = run_allocate(state, batch_size=1)
+    assert not np.asarray(res.allocated)[0]   # qa is capped to zero
+    assert np.asarray(res.allocated)[1]       # qb unaffected by ga's failure
+
+
+def test_unschedulable_marking_and_churn_reset():
+    """scheduling_backoff=1: one failed cycle marks the group
+    unschedulable; the snapshot then skips it; pod churn clears it."""
+    from kai_scheduler_tpu.controllers.podgroup_controller import \
+        PodGroupController
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups = [apis.PodGroup("huge", queue="q", min_member=1,
+                            scheduling_backoff=1)]
+    pods = [apis.Pod("hp", "huge", apis.ResourceVec(16, 1, 1))]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    sched = Scheduler()
+    ctl = PodGroupController()
+    ctl.reconcile(cluster)
+    sched.run_once(cluster)
+    g = cluster.pod_groups["huge"]
+    assert g.unschedulable and g.unschedulable_reason
+    assert g.phase == apis.PodGroupPhase.UNSCHEDULABLE
+
+    # while marked, the gang is skipped (not attempted, reason untouched)
+    r2 = sched.run_once(cluster)
+    assert not np.asarray(r2.tensors.attempted)[0]
+
+    # pod churn (a new pending pod) clears the condition
+    cluster.pods["hp2"] = apis.Pod("hp2", "huge", apis.ResourceVec(1, 1, 1))
+    ctl.reconcile(cluster)
+    assert not g.unschedulable
+
+
+def test_default_backoff_never_marks():
+    """Default scheduling_backoff=-1: fit failures accumulate but the
+    group keeps being retried (ref NoSchedulingBackoff default)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups = [apis.PodGroup("huge", queue="q", min_member=1)]
+    pods = [apis.Pod("hp", "huge", apis.ResourceVec(16, 1, 1))]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    sched = Scheduler()
+    sched.run_once(cluster)
+    sched.run_once(cluster)
+    g = cluster.pod_groups["huge"]
+    assert g.fit_failures == 2 and not g.unschedulable

@@ -1,0 +1,124 @@
+"""Hierarchical subgroup gang allocation — ref
+``actions/common/allocate.go:71-140`` (allocateSubGroupSet) and the
+``allocate_subgroups_test.go`` shapes: per-subgroup quorums and
+per-subgroup topology domains, atomic per chunk."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.state import build_snapshot
+
+TOPO = apis.Topology("t", levels=["rack", "host"])
+
+
+def _rack_nodes(racks=2, per_rack=2, accel=2.0):
+    return [
+        apis.Node(f"n{r}-{i}", apis.ResourceVec(accel, 64, 256),
+                  labels={"rack": f"r{r}", "host": f"n{r}-{i}"})
+        for r in range(racks) for i in range(per_rack)]
+
+
+def _queue():
+    return [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+
+
+def _pytorch_gang(workers=4, rack_required=True):
+    """Leader(min 1) + workers(min N, rack-constrained) — the
+    PyTorchJob-style subgroup tree the podgrouper produces."""
+    tc = (apis.TopologyConstraint(topology="t", required_level="rack")
+          if rack_required else None)
+    group = apis.PodGroup(
+        "ptj", queue="q", min_member=1 + workers,
+        sub_groups=[
+            apis.SubGroup("leader", min_member=1),
+            apis.SubGroup("worker", min_member=workers,
+                          topology_constraint=tc),
+        ])
+    pods = [apis.Pod("leader-0", "ptj", apis.ResourceVec(1, 1, 1),
+                     subgroup="leader")]
+    pods += [apis.Pod(f"worker-{i}", "ptj", apis.ResourceVec(1, 1, 1),
+                      subgroup="worker") for i in range(workers)]
+    return group, pods
+
+
+def run_allocate(state, **cfg):
+    fs = drf.set_fair_share(state, num_levels=1)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    return allocate(state, fs, num_levels=1, config=AllocateConfig(**cfg))
+
+
+def test_subgroup_rack_constraint_packs_workers_in_one_rack():
+    group, pods = _pytorch_gang(workers=4)
+    state, idx = build_snapshot(_rack_nodes(), _queue(), [group], pods,
+                                TOPO)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    pl = np.asarray(res.placements)[0]
+    names = [idx.node_names[n] for n in pl if n >= 0]
+    assert len(names) == 5
+    # tasks sort leader-first (same priority, name order keeps input
+    # order); workers are the rack-constrained subgroup — all 4 workers
+    # share one rack
+    worker_nodes = [idx.node_names[pl[t]]
+                    for t, pod in enumerate(idx.task_names[0])
+                    if pod and pod.startswith("worker")]
+    racks = {n.split("-")[0] for n in worker_nodes}
+    assert len(racks) == 1, worker_nodes
+
+
+def test_subgroup_gang_fails_atomically_when_rack_too_small():
+    """5 workers need one rack; racks hold only 4 accel: nothing places."""
+    group, pods = _pytorch_gang(workers=5)
+    state, _ = build_snapshot(_rack_nodes(), _queue(), [group], pods, TOPO)
+    res = run_allocate(state)
+    assert not np.asarray(res.allocated)[0]
+    assert (np.asarray(res.placements)[0] == -1).all()
+
+
+def test_subgroup_quorums_enforced_independently():
+    """Leader fits but workers' quorum does not -> atomic failure, even
+    though gang min_member would allow elastic partial placement."""
+    group, pods = _pytorch_gang(workers=4, rack_required=False)
+    group.min_member = 1  # gang-level would tolerate leader alone
+    nodes = [apis.Node("only", apis.ResourceVec(2, 64, 256))]
+    state, _ = build_snapshot(nodes, _queue(), [group], pods)
+    res = run_allocate(state)
+    assert not np.asarray(res.allocated)[0]
+
+
+def test_subgroups_unconstrained_span_racks():
+    """Without the rack constraint 5 workers may span racks."""
+    group, pods = _pytorch_gang(workers=5, rack_required=False)
+    state, _ = build_snapshot(_rack_nodes(), _queue(), [group], pods, TOPO)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    assert int((np.asarray(res.placements)[0] >= 0).sum()) == 6
+
+
+def test_subgroup_running_pods_count_toward_quorum():
+    """Workers already running reduce the subgroup's needed quorum."""
+    group, pods = _pytorch_gang(workers=4, rack_required=False)
+    # two workers already running on a node
+    nodes = _rack_nodes()
+    running = [
+        apis.Pod(f"old-worker-{i}", "ptj", apis.ResourceVec(1, 1, 1),
+                 subgroup="worker", status=apis.PodStatus.RUNNING,
+                 node="n0-0") for i in range(2)]
+    pending = [p for p in pods if p.name in
+               ("leader-0", "worker-0", "worker-1")]
+    state, _ = build_snapshot(nodes, _queue(), [group], running + pending,
+                              TOPO)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    assert int((np.asarray(res.placements)[0] >= 0).sum()) == 3
+
+
+def test_end_to_end_cycle_with_subgroups():
+    group, pods = _pytorch_gang(workers=4)
+    cluster = Cluster.from_objects(_rack_nodes(), _queue(), [group], pods,
+                                   TOPO)
+    res = Scheduler().run_once(cluster)
+    assert len(res.bind_requests) == 5

@@ -1,0 +1,230 @@
+"""Wide-predicate tests: taints/tolerations, node-affinity operators,
+inter-pod (anti-)affinity, nominated node — the analogue of the upstream
+filter surface wrapped by ``k8s_internal/predicates/predicates.go:70-140``
+and the ``podaffinity`` / ``nominatednode`` plugins."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.state import build_snapshot
+
+
+def run_allocate(state, *, num_levels=1, **cfg):
+    fs = drf.set_fair_share(state, num_levels=num_levels)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    return allocate(state, fs, num_levels=num_levels,
+                    config=AllocateConfig(**cfg))
+
+
+def _one_queue():
+    return [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+
+
+def test_hard_taint_excludes_untolerated_pod():
+    nodes = [apis.Node("tainted", apis.ResourceVec(8, 64, 256),
+                       taints=[apis.Taint("dedicated", "infra")])]
+    groups = [apis.PodGroup("g", queue="q", min_member=1)]
+    pods = [apis.Pod("p", "g", apis.ResourceVec(1, 1, 1))]
+    state, _ = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert not np.asarray(res.allocated)[0]
+
+
+def test_toleration_admits_pod_equal_and_exists():
+    nodes = [apis.Node("tainted", apis.ResourceVec(8, 64, 256),
+                       taints=[apis.Taint("dedicated", "infra")])]
+    groups = [apis.PodGroup("ge", queue="q", min_member=1),
+              apis.PodGroup("gx", queue="q", min_member=1),
+              apis.PodGroup("gw", queue="q", min_member=1)]
+    pods = [
+        apis.Pod("pe", "ge", apis.ResourceVec(1, 1, 1),
+                 tolerations=[apis.Toleration("dedicated", "Equal", "infra")]),
+        apis.Pod("px", "gx", apis.ResourceVec(1, 1, 1),
+                 tolerations=[apis.Toleration("dedicated", "Exists")]),
+        # wrong value on an Equal toleration does NOT tolerate
+        apis.Pod("pw", "gw", apis.ResourceVec(1, 1, 1),
+                 tolerations=[apis.Toleration("dedicated", "Equal", "other")]),
+    ]
+    state, _ = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    allocated = np.asarray(res.allocated)
+    assert allocated[0] and allocated[1] and not allocated[2]
+
+
+def test_prefer_noschedule_is_soft():
+    """PreferNoSchedule steers away from the tainted node but does not
+    exclude it when it is the only option."""
+    nodes = [
+        apis.Node("pref-tainted", apis.ResourceVec(8, 64, 256),
+                  taints=[apis.Taint("flaky", "", "PreferNoSchedule")]),
+        apis.Node("clean", apis.ResourceVec(8, 64, 256)),
+    ]
+    groups = [apis.PodGroup("g", queue="q", min_member=1)]
+    pods = [apis.Pod("p", "g", apis.ResourceVec(1, 1, 1))]
+    state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert idx.node_names[int(np.asarray(res.placements)[0, 0])] == "clean"
+
+    # only the tainted node exists -> still schedulable
+    state2, _ = build_snapshot(nodes[:1], _one_queue(), groups, pods)
+    res2 = run_allocate(state2)
+    assert np.asarray(res2.allocated)[0]
+
+
+def test_node_affinity_operators():
+    nodes = [
+        apis.Node("a", apis.ResourceVec(8, 64, 256),
+                  labels={"zone": "z1", "gen": "7"}),
+        apis.Node("b", apis.ResourceVec(8, 64, 256),
+                  labels={"zone": "z2", "gen": "5"}),
+        apis.Node("c", apis.ResourceVec(8, 64, 256)),
+    ]
+    cases = [
+        ([apis.AffinityExpr("zone", "In", ("z1", "z3"))], {"a"}),
+        ([apis.AffinityExpr("zone", "NotIn", ("z1",))], {"b", "c"}),
+        ([apis.AffinityExpr("zone", "Exists")], {"a", "b"}),
+        ([apis.AffinityExpr("zone", "DoesNotExist")], {"c"}),
+        ([apis.AffinityExpr("gen", "Gt", ("6",))], {"a"}),
+        ([apis.AffinityExpr("gen", "Lt", ("6",))], {"b"}),
+        # ANDed expressions
+        ([apis.AffinityExpr("zone", "Exists"),
+          apis.AffinityExpr("gen", "Lt", ("6",))], {"b"}),
+    ]
+    for exprs, expected in cases:
+        groups = [apis.PodGroup("g", queue="q", min_member=3)]
+        pods = [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1),
+                         node_affinity=list(exprs)) for i in range(3)]
+        state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+        res = run_allocate(state)
+        if len(expected) >= 3:
+            assert np.asarray(res.allocated)[0], exprs
+        pl = np.asarray(res.placements)[0]
+        placed_nodes = {idx.node_names[n] for n in pl if n >= 0}
+        assert placed_nodes <= expected, (exprs, placed_nodes, expected)
+
+
+def test_required_pod_anti_affinity_against_running():
+    """A required anti-affinity term keeps the new pod off nodes already
+    running pods matching the selector."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256)),
+             apis.Node("n1", apis.ResourceVec(8, 64, 256))]
+    groups = [apis.PodGroup("old", queue="q", min_member=1,
+                            last_start_timestamp=0.0),
+              apis.PodGroup("new", queue="q", min_member=1)]
+    pods = [
+        apis.Pod("vic", "old", apis.ResourceVec(1, 1, 1),
+                 status=apis.PodStatus.RUNNING, node="n0",
+                 labels={"app": "db"}),
+        apis.Pod("inc", "new", apis.ResourceVec(1, 1, 1),
+                 pod_affinity=[apis.PodAffinityTerm(
+                     match_labels=(("app", "db"),), anti=True)]),
+    ]
+    state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert idx.node_names[int(np.asarray(res.placements)[1, 0])] == "n1"
+
+
+def test_required_pod_affinity_colocates():
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256)),
+             apis.Node("n1", apis.ResourceVec(8, 64, 256))]
+    groups = [apis.PodGroup("old", queue="q", min_member=1,
+                            last_start_timestamp=0.0),
+              apis.PodGroup("new", queue="q", min_member=1)]
+    pods = [
+        apis.Pod("svc", "old", apis.ResourceVec(1, 1, 1),
+                 status=apis.PodStatus.RUNNING, node="n1",
+                 labels={"app": "cache"}),
+        apis.Pod("inc", "new", apis.ResourceVec(1, 1, 1),
+                 pod_affinity=[apis.PodAffinityTerm(
+                     match_labels=(("app", "cache"),))]),
+    ]
+    state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert idx.node_names[int(np.asarray(res.placements)[1, 0])] == "n1"
+
+
+def test_self_anti_affinity_spreads_gang():
+    """Gang whose pods anti-affine to their own label: one task per node."""
+    nodes = [apis.Node(f"n{i}", apis.ResourceVec(8, 64, 256))
+             for i in range(3)]
+    groups = [apis.PodGroup("g", queue="q", min_member=3)]
+    pods = [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1),
+                     labels={"app": "web"},
+                     pod_affinity=[apis.PodAffinityTerm(
+                         match_labels=(("app", "web"),), anti=True)])
+            for i in range(3)]
+    state, _ = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    pl = np.asarray(res.placements)[0]
+    placed = pl[pl >= 0]
+    assert len(placed) == 3 and len(set(placed.tolist())) == 3
+
+    # 4 pods onto 3 nodes with the same constraint: gang cannot place
+    groups4 = [apis.PodGroup("g", queue="q", min_member=4)]
+    pods4 = pods + [apis.Pod("p3", "g", apis.ResourceVec(1, 1, 1),
+                             labels={"app": "web"},
+                             pod_affinity=[apis.PodAffinityTerm(
+                                 match_labels=(("app", "web"),), anti=True)])]
+    state4, _ = build_snapshot(nodes, _one_queue(), groups4, pods4)
+    res4 = run_allocate(state4)
+    assert not np.asarray(res4.allocated)[0]
+
+
+def test_self_anti_affinity_at_rack_level():
+    """Anti-affinity at a coarser topology level spreads across racks."""
+    topo = apis.Topology("t", levels=["rack", "host"])
+    nodes = [apis.Node(f"n{i}", apis.ResourceVec(8, 64, 256),
+                       labels={"rack": f"r{i // 2}", "host": f"n{i}"})
+             for i in range(4)]
+    groups = [apis.PodGroup("g", queue="q", min_member=2)]
+    pods = [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1),
+                     labels={"app": "web"},
+                     pod_affinity=[apis.PodAffinityTerm(
+                         match_labels=(("app", "web"),), anti=True,
+                         topology_key="rack")])
+            for i in range(2)]
+    state, _ = build_snapshot(nodes, _one_queue(), groups, pods, topo)
+    res = run_allocate(state)
+    assert np.asarray(res.allocated)[0]
+    pl = np.asarray(res.placements)[0]
+    racks = {int(n) // 2 for n in pl if n >= 0}
+    assert len(racks) == 2
+
+
+def test_nominated_node_dominates_scoring():
+    """The nominatednode bonus outweighs binpack preferences."""
+    nodes = [apis.Node("full-ish", apis.ResourceVec(8, 64, 256)),
+             apis.Node("target", apis.ResourceVec(8, 64, 256))]
+    groups = [apis.PodGroup("old", queue="q", min_member=1,
+                            last_start_timestamp=0.0),
+              apis.PodGroup("new", queue="q", min_member=1)]
+    pods = [
+        # make full-ish the binpack favourite
+        apis.Pod("filler", "old", apis.ResourceVec(6, 6, 6),
+                 status=apis.PodStatus.RUNNING, node="full-ish"),
+        apis.Pod("inc", "new", apis.ResourceVec(1, 1, 1),
+                 nominated_node="target"),
+    ]
+    state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+    res = run_allocate(state)
+    assert idx.node_names[int(np.asarray(res.placements)[1, 0])] == "target"
+
+
+def test_filter_class_dedup():
+    """Identical specs share one class; snapshot hints derive correctly."""
+    from kai_scheduler_tpu.state.node_filters import pod_filter_spec
+    tol = [apis.Toleration("dedicated", "Exists")]
+    p1 = apis.Pod("a", "g", tolerations=list(tol))
+    p2 = apis.Pod("b", "g", tolerations=list(tol))
+    assert pod_filter_spec(p1) == pod_filter_spec(p2)
+
+    nodes = [apis.Node("n", apis.ResourceVec(8, 64, 256))]
+    groups = [apis.PodGroup("g", queue="q", min_member=2)]
+    pods = [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1),
+                     tolerations=list(tol)) for i in range(2)]
+    state, idx = build_snapshot(nodes, _one_queue(), groups, pods)
+    # class 0 (empty) + one shared class for the two pods
+    assert state.nodes.filter_masks.shape[0] == 2
+    assert idx.uniform_gangs
