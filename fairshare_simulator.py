@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Fairshare simulator — HTTP parity harness for the DRF division kernel.
+
+Re-implements ``cmd/fairshare-simulator`` (see its README): POST
+``/simulate`` with the same JSON schema —
+
+    {"totalResource": {"GPU": 100, "CPU": 16000, "Memory": 32e6},
+     "queues": [{"uid": "q1", "priority": 0,
+                 "resourceShare": {"gpu": {"deserved": 10, "request": 100,
+                                           "overQuotaWeight": 3,
+                                           "maxAllowed": -1, "usage": 0}}}]}
+
+— and receive ``{uid: {"gpu": fair, "cpu": fair, "memory": fair}}``.
+``kValue`` may be set per request (the time-based-fairshare-simulator's
+knob); per-resource ``usage`` feeds the k term (normalized
+usage/clusterCapacity, ref ``resource_division.go:238-246``).
+
+Run: ``python fairshare_simulator.py --port 8080`` or one-shot:
+``python fairshare_simulator.py --simulate request.json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+_RES_KEYS = ("gpu", "cpu", "memory")   # maps to (accel, cpu, memory)
+_UNLIMITED = -1.0
+
+
+def simulate(request: dict) -> dict:
+    """Pure function: request dict → {uid: {gpu, cpu, memory}}."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kai_scheduler_tpu.ops import drf
+    from kai_scheduler_tpu.state.cluster_state import QueueState, _round_up
+
+    queues = request.get("queues", [])
+    total_in = {k.lower(): float(v)
+                for k, v in request.get("totalResource", {}).items()}
+    total = np.array([total_in.get("gpu", 0.0), total_in.get("cpu", 0.0),
+                      total_in.get("memory", 0.0)], np.float32)
+    k_value = float(request.get("kValue", 0.0))
+
+    nq = len(queues)
+    Q = _round_up(max(nq, 1), 8)
+    quota = np.zeros((Q, 3), np.float32)
+    weight = np.ones((Q, 3), np.float32)
+    limit = np.full((Q, 3), _UNLIMITED, np.float32)
+    req = np.zeros((Q, 3), np.float32)
+    usage = np.zeros((Q, 3), np.float32)
+    prio = np.zeros((Q,), np.int32)
+    valid = np.zeros((Q,), bool)
+    for i, q in enumerate(queues):
+        valid[i] = True
+        prio[i] = int(q.get("priority", 0))
+        share = {k.lower(): v
+                 for k, v in q.get("resourceShare", {}).items()}
+        for r, key in enumerate(_RES_KEYS):
+            spec = share.get(key, {}) or {}
+            quota[i, r] = float(spec.get("deserved", 0.0))
+            weight[i, r] = float(spec.get("overQuotaWeight", 1.0))
+            limit[i, r] = float(spec.get("maxAllowed", _UNLIMITED))
+            req[i, r] = float(spec.get("request", 0.0))
+            usage[i, r] = float(spec.get("usage", 0.0))
+
+    qs = QueueState(
+        parent=jnp.full((Q,), -1, jnp.int32),
+        depth=jnp.zeros((Q,), jnp.int32),
+        priority=jnp.asarray(prio),
+        quota=jnp.asarray(quota),
+        over_quota_weight=jnp.asarray(weight),
+        limit=jnp.asarray(limit),
+        allocated=jnp.zeros((Q, 3), jnp.float32),
+        allocated_nonpreemptible=jnp.zeros((Q, 3), jnp.float32),
+        request=jnp.asarray(req),
+        usage=jnp.asarray(usage),
+        fair_share=jnp.zeros((Q, 3), jnp.float32),
+        valid=jnp.asarray(valid),
+        creation_order=jnp.arange(Q, dtype=jnp.int32),
+        preempt_min_runtime=jnp.zeros((Q,), jnp.float32),
+        reclaim_min_runtime=jnp.zeros((Q,), jnp.float32),
+    )
+    seg_total = jnp.concatenate(
+        [jnp.asarray(total)[None, :], jnp.zeros((Q, 3), jnp.float32)],
+        axis=0)
+    fs = np.asarray(drf.divide_level(
+        qs, seg_total, jnp.asarray(valid), jnp.asarray(k_value)))
+    out = {}
+    for i, q in enumerate(queues):
+        uid = q.get("uid", q.get("name", f"queue{i}"))
+        out[uid] = {"gpu": float(fs[i, 0]), "cpu": float(fs[i, 1]),
+                    "memory": float(fs[i, 2])}
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        if self.path != "/simulate":
+            self.send_error(404)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length).decode())
+            resp = json.dumps(simulate(req)).encode()
+        except Exception as exc:  # noqa: BLE001 — mirror the ref's 400
+            self.send_error(400, str(exc))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--simulate", metavar="REQUEST_JSON",
+                    help="one-shot: read request file ('-' = stdin), "
+                         "print response, exit")
+    args = ap.parse_args()
+    if args.simulate:
+        src = (sys.stdin if args.simulate == "-"
+               else open(args.simulate, encoding="utf-8"))
+        with src:
+            print(json.dumps(simulate(json.load(src)), indent=2,
+                             sort_keys=True))
+        return 0
+    srv = HTTPServer(("", args.port), _Handler)
+    print(f"fairshare-simulator listening on :{args.port}")
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

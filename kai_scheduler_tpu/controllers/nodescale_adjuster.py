@@ -1,0 +1,93 @@
+"""Node-scale adjuster — autoscaler hinting for fractional accelerators.
+
+Reference (``pkg/nodescaleadjuster/scale_adjuster/scale_adjuster.go:50-70``):
+cluster autoscalers cannot reason about fractional-GPU requests, so for
+every unschedulable fractional pod the adjuster creates a *scaling pod*
+(``cmd/scalingpod`` — an intentionally inert sleeper) that requests the
+equivalent number of WHOLE devices; the autoscaler sees a plain
+unschedulable GPU pod and provisions a node, after which the real pod
+schedules and the scaling pod is deleted.  A cool-down window bounds
+churn.
+
+Here scaling pods are inert ``Pod`` objects in the hub whose group is
+the reserved ``SCALING_GROUP`` — the snapshot builder drops pods of
+unknown groups, so the scheduler never sees them; a simulated (or real)
+autoscaler watches them instead.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+
+#: reserved group name — not a PodGroup, so snapshots ignore these pods
+SCALING_GROUP = "kai-scale-adjust"
+_PREFIX = "scaling-pod-"
+
+
+@dataclasses.dataclass
+class ScaleAdjuster:
+    """ref ScaleAdjuster: Adjust() creates/deletes scaling pods."""
+
+    cool_down_s: float = 30.0
+    #: GiB of device memory equated to one whole device when translating
+    #: memory-based requests (ref gpuMemoryToFractionRatio)
+    gpu_memory_to_fraction_gib: float = 16.0
+    _last_scale_up: float = dataclasses.field(default=-1.0)
+
+    def adjust(self, cluster: Cluster) -> dict[str, list[str]]:
+        """One reconcile sweep.  Returns {"created": [...], "deleted": [...]}."""
+        created: list[str] = []
+        deleted: list[str] = []
+
+        # fractional pods currently unschedulable (their group was marked
+        # by the scheduler's fit-failure status flow)
+        needy: list[apis.Pod] = []
+        for pod in cluster.pods.values():
+            if pod.status != apis.PodStatus.PENDING:
+                continue
+            if pod.group == SCALING_GROUP:
+                continue
+            if pod.accel_portion <= 0 and pod.accel_memory_gib <= 0:
+                continue
+            group = cluster.pod_groups.get(pod.group)
+            if group is not None and (group.unschedulable
+                                      or group.fit_failures > 0):
+                needy.append(pod)
+
+        # delete scaling pods whose trigger pod is gone or schedulable
+        needy_names = {p.name for p in needy}
+        for name in list(cluster.pods):
+            pod = cluster.pods[name]
+            if pod.group != SCALING_GROUP:
+                continue
+            trigger = name[len(_PREFIX):]
+            if trigger not in needy_names:
+                del cluster.pods[name]
+                deleted.append(name)
+
+        in_cooldown = (self._last_scale_up >= 0 and
+                       cluster.now - self._last_scale_up < self.cool_down_s)
+        if in_cooldown:
+            return {"created": created, "deleted": deleted}
+
+        for pod in needy:
+            name = _PREFIX + pod.name
+            if name in cluster.pods:
+                continue
+            whole = (pod.accel_portion if pod.accel_portion > 0
+                     else pod.accel_memory_gib
+                     / max(self.gpu_memory_to_fraction_gib, 1e-9))
+            scaling = apis.Pod(
+                name=name, group=SCALING_GROUP,
+                resources=apis.ResourceVec(
+                    accel=float(math.ceil(whole - 1e-9)),
+                    cpu=pod.resources.cpu, memory=pod.resources.memory),
+                creation_timestamp=cluster.now)
+            cluster.pods[name] = scaling
+            created.append(name)
+        if created:
+            self._last_scale_up = cluster.now
+        return {"created": created, "deleted": deleted}

@@ -1,0 +1,90 @@
+"""Operator — assembles and reconciles every runtime component.
+
+The reference operator (``pkg/operator``) watches the ``Config`` and
+``SchedulingShard`` CRDs and deploys/configures one scheduler per shard
+plus the binder, podgrouper, controllers and scale adjuster (operands in
+``pkg/operator/operands/``).  In-process that deployment role becomes a
+composition root: ``Operator.reconcile()`` (re)builds the component set
+from the current ``Config``, and ``run_cycle`` drives one full control
+loop — intake → status controllers → per-shard scheduling → binding →
+scale adjustment — the same dataflow the reference runs as separate
+binaries around the API server.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .apis import types as apis
+from .binder.binder import Binder
+from .controllers.nodescale_adjuster import ScaleAdjuster
+from .controllers.podgroup_controller import PodGroupController
+from .controllers.queue_controller import QueueController
+from .framework.scheduler import CycleResult, Scheduler, SchedulerConfig
+from .framework.session import SessionConfig
+from .podgrouper.reconciler import PodGroupReconciler
+from .runtime.cluster import Cluster
+from .runtime.usagedb import (UsageLister, UsageParams,
+                              cluster_allocation_client,
+                              cluster_capacity_fn)
+
+
+class Operator:
+    """Deploys (instantiates) and reconciles the component set."""
+
+    def __init__(self, config: apis.Config | None = None,
+                 cluster: Cluster | None = None,
+                 usage_params: UsageParams | None = None):
+        self.config = config or apis.Config()
+        self.cluster = cluster or Cluster()
+        self.podgrouper = PodGroupReconciler()
+        self.podgroup_controller = PodGroupController()
+        self.queue_controller = QueueController()
+        self.binder = Binder()
+        self.scale_adjuster = ScaleAdjuster(
+            cool_down_s=self.config.stale_gang_grace_s)
+        self.usage_lister = None
+        if usage_params is not None:
+            self.usage_lister = UsageLister(
+                cluster_allocation_client(self.cluster), usage_params,
+                capacity_fn=cluster_capacity_fn(self.cluster))
+        self.schedulers: dict[str, Scheduler] = {}
+        self.reconcile()
+
+    def reconcile(self) -> None:
+        """Render one Scheduler per shard from the Config — the operand
+        reconciliation (``pkg/operator/controller/schedulingshard_controller``).
+        A config with no shards gets the default (partition-less) one."""
+        shards = list(self.config.shards) or [apis.SchedulingShard()]
+        desired = {s.name for s in shards}
+        for name in list(self.schedulers):
+            if name not in desired:
+                del self.schedulers[name]
+        for shard in shards:
+            self.schedulers[shard.name] = Scheduler(
+                SchedulerConfig(
+                    session=SessionConfig(),
+                    schedule_period_s=self.config.schedule_period_s,
+                    shard=shard),
+                usage_lister=self.usage_lister)
+
+    def run_cycle(self) -> dict[str, CycleResult]:
+        """One full control-plane sweep over every component."""
+        cluster = self.cluster
+        self.podgrouper.reconcile(cluster)
+        self.podgroup_controller.reconcile(cluster)
+        self.queue_controller.reconcile(cluster)
+        results = {name: sched.run_once(cluster)
+                   for name, sched in self.schedulers.items()}
+        self.binder.reconcile(cluster)
+        self.scale_adjuster.adjust(cluster)
+        return results
+
+
+def run(operator: Operator, cycles: int, tick_s: float | None = None):
+    """Drive the operator for ``cycles`` control loops (simulation aid)."""
+    out = []
+    for _ in range(cycles):
+        out.append(operator.run_cycle())
+        operator.cluster.tick(tick_s if tick_s is not None
+                              else operator.config.schedule_period_s)
+    return out

@@ -1,0 +1,160 @@
+"""Whole-cluster snapshot to JSON + deterministic replay.
+
+The reference's main production-debugging artifact: the snapshot plugin
+serializes every raw object the scheduler sees to zipped JSON
+(``plugins/snapshot/snapshot.go:40-60``), and ``cmd/snapshot-tool``
+(``main.go:30-90``) loads it into fake clients and re-runs a full
+scheduling cycle offline.  Here the cluster hub IS the object store, so
+the snapshot is a JSON rendering of it plus the scheduler config; replay
+builds a fresh ``Cluster`` and runs ``Scheduler.run_once``.  Replaying
+the same snapshot twice yields byte-identical commit sets (the kernels
+are deterministic functions of the snapshot).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import gzip
+import json
+from typing import Any
+
+from ..apis import types as apis
+from .cluster import Cluster
+
+SNAPSHOT_VERSION = 1
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def _resource_vec(d: dict) -> apis.ResourceVec:
+    return apis.ResourceVec(**d)
+
+
+def _queue_resource(d: dict) -> apis.QueueResource:
+    return apis.QueueResource(**d)
+
+
+def _queue(d: dict) -> apis.Queue:
+    d = dict(d)
+    for k in ("accel", "cpu", "memory"):
+        d[k] = _queue_resource(d[k])
+    return apis.Queue(**d)
+
+
+def _taint(d: dict) -> apis.Taint:
+    return apis.Taint(**d)
+
+
+def _node(d: dict) -> apis.Node:
+    d = dict(d)
+    d["allocatable"] = _resource_vec(d["allocatable"])
+    d["taints"] = [_taint(t) for t in d.get("taints", [])]
+    return apis.Node(**d)
+
+
+def _topology_constraint(d: dict | None) -> apis.TopologyConstraint | None:
+    return None if d is None else apis.TopologyConstraint(**d)
+
+
+def _sub_group(d: dict) -> apis.SubGroup:
+    d = dict(d)
+    d["topology_constraint"] = _topology_constraint(
+        d.get("topology_constraint"))
+    return apis.SubGroup(**d)
+
+
+def _pod_group(d: dict) -> apis.PodGroup:
+    d = dict(d)
+    d["preemptibility"] = apis.Preemptibility(d["preemptibility"])
+    d["phase"] = apis.PodGroupPhase(d["phase"])
+    d["topology_constraint"] = _topology_constraint(
+        d.get("topology_constraint"))
+    d["sub_groups"] = [_sub_group(s) for s in d.get("sub_groups", [])]
+    return apis.PodGroup(**d)
+
+
+def _pod(d: dict) -> apis.Pod:
+    d = dict(d)
+    d["resources"] = _resource_vec(d["resources"])
+    d["status"] = apis.PodStatus(d["status"])
+    d["tolerations"] = [apis.Toleration(**t)
+                        for t in d.get("tolerations", [])]
+    d["node_affinity"] = [
+        apis.AffinityExpr(key=e["key"], operator=e["operator"],
+                          values=tuple(e.get("values", ())))
+        for e in d.get("node_affinity", [])]
+    d["pod_affinity"] = [
+        apis.PodAffinityTerm(
+            match_labels=tuple(tuple(kv) for kv in t.get("match_labels", ())),
+            topology_key=t.get("topology_key", "kubernetes.io/hostname"),
+            anti=t.get("anti", False), required=t.get("required", True))
+        for t in d.get("pod_affinity", [])]
+    return apis.Pod(**d)
+
+
+def _bind_request(d: dict) -> apis.BindRequest:
+    d = dict(d)
+    d["received_resource_type"] = apis.ReceivedResourceType(
+        d["received_resource_type"])
+    return apis.BindRequest(**d)
+
+
+def dump_cluster(cluster: Cluster) -> dict:
+    """Cluster → JSON-ready dict (the RawKubernetesObjects analogue)."""
+    return {
+        "version": SNAPSHOT_VERSION,
+        "now": cluster.now,
+        "nodes": [_to_jsonable(n) for n in cluster.nodes.values()],
+        "queues": [_to_jsonable(q) for q in cluster.queues.values()],
+        "pod_groups": [_to_jsonable(g) for g in cluster.pod_groups.values()],
+        "pods": [_to_jsonable(p) for p in cluster.pods.values()],
+        "topology": _to_jsonable(cluster.topology),
+        "bind_requests": [_to_jsonable(b)
+                          for b in cluster.bind_requests.values()],
+        "restarting": sorted(cluster.restarting),
+    }
+
+
+def load_cluster(doc: dict) -> Cluster:
+    """Inverse of :func:`dump_cluster`."""
+    if doc.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version {doc.get('version')}")
+    topo = (apis.Topology(**doc["topology"])
+            if doc.get("topology") else None)
+    cluster = Cluster.from_objects(
+        [_node(d) for d in doc["nodes"]],
+        [_queue(d) for d in doc["queues"]],
+        [_pod_group(d) for d in doc["pod_groups"]],
+        [_pod(d) for d in doc["pods"]],
+        topo)
+    cluster.now = doc.get("now", 0.0)
+    for d in doc.get("bind_requests", []):
+        br = _bind_request(d)
+        cluster.bind_requests[br.pod_name] = br
+    cluster.restarting = set(doc.get("restarting", []))
+    return cluster
+
+
+def save(cluster: Cluster, path: str) -> None:
+    """Write a (gzipped, like the reference's zip) snapshot file."""
+    data = json.dumps(dump_cluster(cluster), sort_keys=True).encode()
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(data)
+
+
+def load(path: str) -> Cluster:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        return load_cluster(json.loads(f.read().decode()))

@@ -1,0 +1,166 @@
+"""Time-based fairshare usage source — ref ``pkg/scheduler/cache/usagedb``.
+
+The reference polls a Prometheus-backed ``UsageLister`` every
+``fetchInterval`` (default 1m) for each queue's allocation metrics
+aggregated over a decay window, normalizes by the cluster-capacity
+integral over the same window, and hands the result to the proportion
+plugin, where the over-quota share weight becomes
+``max(0, w + k*(w - usage))`` (``resource_division.go:238-246``).  Stale
+data (older than ``stalenessPeriod``, default 5× fetch interval) is
+rejected so a dead metrics pipeline degrades to plain weight-based
+fairness instead of frozen history (``usagedb.go:20-60``).
+
+Here the same shape is a host-side accumulator: a pluggable client
+reports instantaneous per-queue allocation; the lister integrates it
+into either
+
+- a **sliding window with exponential decay** (``halfLifePeriod``, ref
+  ``prometheus.go`` getExponentialDecayQuery), or
+- a **tumbling window** that resets on a fixed period boundary (ref
+  cron-reset tumbling windows),
+
+and exposes usage normalized by the capacity integral — exactly the
+``usage/clusterCapacity`` quantity the division kernel's ``k_value``
+term expects (``ops/drf.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Mapping
+
+import numpy as np
+
+from ..apis.types import NUM_RESOURCES
+
+#: client signature: now -> {queue name: allocation vector [R]}
+UsageClient = Callable[[float], Mapping[str, np.ndarray]]
+
+
+@dataclasses.dataclass
+class UsageParams:
+    """ref ``cache/usagedb/api`` UsageParams + defaults."""
+
+    window_type: str = "sliding"          # "sliding" | "tumbling"
+    half_life_s: float | None = 3600.0    # sliding decay half-life
+    tumbling_window_s: float = 24 * 3600.0
+    tumbling_window_start: float = 0.0
+    fetch_interval_s: float = 60.0
+    staleness_period_s: float | None = None   # default 5x fetch interval
+
+    def staleness(self) -> float:
+        if self.staleness_period_s is None:
+            return 5.0 * self.fetch_interval_s
+        return max(self.staleness_period_s, self.fetch_interval_s)
+
+
+class UsageLister:
+    """Poll-driven usage accumulator with staleness semantics."""
+
+    def __init__(self, client: UsageClient, params: UsageParams | None = None,
+                 capacity_fn: Callable[[float], np.ndarray] | None = None):
+        self.client = client
+        self.params = params or UsageParams()
+        #: instantaneous cluster capacity [R] (integrated alongside usage)
+        self.capacity_fn = capacity_fn
+        self._usage: dict[str, np.ndarray] = {}
+        self._capacity_integral = np.zeros((NUM_RESOURCES,), np.float64)
+        self._last_fetch: float | None = None
+        self._last_data_time: float | None = None
+
+    # -- the poll loop body (driver calls this; ref usagedb.go Start) ------
+
+    def maybe_fetch(self, now: float) -> bool:
+        """Fetch + integrate if ``fetch_interval`` elapsed.  Returns True
+        when a fetch happened."""
+        if (self._last_fetch is not None
+                and now - self._last_fetch < self.params.fetch_interval_s):
+            return False
+        self.fetch(now)
+        return True
+
+    def fetch(self, now: float) -> None:
+        """One poll: decay/reset the window, then integrate the client's
+        current allocation report over the elapsed interval."""
+        p = self.params
+        dt = (0.0 if self._last_fetch is None
+              else max(0.0, now - self._last_fetch))
+        if p.window_type == "tumbling":
+            period = max(p.tumbling_window_s, 1e-9)
+            prev_win = (math.floor(((self._last_fetch or now)
+                                    - p.tumbling_window_start) / period))
+            cur_win = math.floor((now - p.tumbling_window_start) / period)
+            if cur_win != prev_win:  # crossed a boundary: reset
+                self._usage.clear()
+                self._capacity_integral[:] = 0.0
+        elif p.half_life_s:
+            decay = 0.5 ** (dt / p.half_life_s)
+            for vec in self._usage.values():
+                vec *= decay
+            self._capacity_integral *= decay
+
+        try:
+            report = self.client(now)
+        except Exception:
+            # fetch failure: keep the last data; staleness will reject it
+            self._last_fetch = now
+            return
+        if dt > 0:
+            for name, alloc in report.items():
+                vec = self._usage.setdefault(
+                    name, np.zeros((NUM_RESOURCES,), np.float64))
+                vec += np.asarray(alloc, np.float64) * dt
+            if self.capacity_fn is not None:
+                self._capacity_integral += (
+                    np.asarray(self.capacity_fn(now), np.float64) * dt)
+        self._last_fetch = now
+        self._last_data_time = now
+
+    # -- consumer side (session open; ref GetResourceUsage) ----------------
+
+    def queue_usage(self, now: float) -> dict[str, np.ndarray] | None:
+        """Normalized usage per queue ([R], fraction of the capacity
+        integral), or None when the data is stale/absent — callers then
+        run plain weight-based fairness (k term inert)."""
+        if self._last_data_time is None:
+            return None
+        if now - self._last_data_time > self.params.staleness():
+            return None
+        cap = np.maximum(self._capacity_integral, 1e-9)
+        return {name: (vec / cap).astype(np.float32)
+                for name, vec in self._usage.items()}
+
+
+def cluster_allocation_client(cluster) -> UsageClient:
+    """A client reporting live per-queue allocation straight from the
+    in-memory hub — the simulation analogue of the queuecontroller's
+    ``kai_queue_allocated_*`` metrics feed (ref
+    ``pkg/queuecontroller/metrics/metrics.go:33-39``)."""
+    from ..apis import types as apis
+
+    def client(now: float) -> dict[str, np.ndarray]:
+        out: dict[str, np.ndarray] = {}
+        for pod in cluster.pods.values():
+            if pod.status not in (apis.PodStatus.BOUND,
+                                  apis.PodStatus.RUNNING):
+                continue
+            group = cluster.pod_groups.get(pod.group)
+            if group is None:
+                continue
+            vec = out.setdefault(
+                group.queue, np.zeros((NUM_RESOURCES,), np.float64))
+            vec += np.asarray(pod.resources.as_tuple(), np.float64)
+        return out
+
+    return client
+
+
+def cluster_capacity_fn(cluster):
+    """Instantaneous cluster allocatable [R] from the hub."""
+    def capacity(now: float) -> np.ndarray:
+        total = np.zeros((NUM_RESOURCES,), np.float64)
+        for node in cluster.nodes.values():
+            if not node.unschedulable:
+                total += np.asarray(node.allocatable.as_tuple(), np.float64)
+        return total
+    return capacity

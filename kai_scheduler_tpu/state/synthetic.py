@@ -30,6 +30,7 @@ def make_cluster(
     running_fraction: float = 0.0,
     priority_spread: int = 1,
     topology_levels: tuple[int, ...] = (),
+    required_level: str | None = None,
     seed: int = 0,
 ) -> tuple[list[apis.Node], list[apis.Queue], list[apis.PodGroup], list[apis.Pod], apis.Topology | None]:
     """Build a synthetic cluster.
@@ -100,6 +101,10 @@ def make_cluster(
             priority=int(rng.integers(0, priority_spread)),
             creation_timestamp=float(g),
             last_start_timestamp=0.0 if running else None,
+            topology_constraint=(
+                apis.TopologyConstraint(topology="default",
+                                        required_level=required_level)
+                if required_level else None),
         )
         pod_groups.append(pg)
         for t in range(tasks_per_gang):

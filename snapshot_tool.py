@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Offline snapshot replay — ref ``cmd/snapshot-tool/main.go:30-90``.
+
+Usage:
+    python snapshot_tool.py dump OUT.json[.gz]        # synthetic demo dump
+    python snapshot_tool.py replay SNAP.json[.gz]     # one cycle, print commits
+
+``replay`` loads a cluster snapshot, runs exactly one scheduling cycle
+against it with the default config, and prints the commit set (bind
+requests + evictions) as JSON lines — deterministic for a given file.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _dump(path: str) -> None:
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    from kai_scheduler_tpu.runtime.snapshot import save
+    from kai_scheduler_tpu.state import make_cluster
+
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=8, node_accel=8.0, num_gangs=8, tasks_per_gang=2)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    save(cluster, path)
+    print(f"wrote synthetic snapshot to {path}")
+
+
+def _replay(path: str) -> None:
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.snapshot import load
+
+    cluster = load(path)
+    result = Scheduler().run_once(cluster)
+    for br in result.bind_requests:
+        print(json.dumps({
+            "kind": "BindRequest", "pod": br.pod_name,
+            "node": br.selected_node,
+            "type": br.received_resource_type.value,
+            "accel_count": br.received_accel_count,
+            "accel_portion": br.received_accel_portion,
+        }, sort_keys=True))
+    for ev in result.evictions:
+        print(json.dumps({
+            "kind": "Eviction", "pod": ev.pod_name, "group": ev.group,
+            "move_to": ev.move_to,
+        }, sort_keys=True))
+    # timings go to stderr so stdout stays byte-identical across replays
+    print(json.dumps({
+        "kind": "Summary",
+        "bind_requests": len(result.bind_requests),
+        "evictions": len(result.evictions),
+    }, sort_keys=True))
+    print(json.dumps({k: round(v, 4)
+                      for k, v in result.action_seconds.items()}),
+          file=sys.stderr)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 3 or argv[1] not in ("dump", "replay"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    (_dump if argv[1] == "dump" else _replay)(argv[2])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

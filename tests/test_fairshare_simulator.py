@@ -1,0 +1,56 @@
+"""Parity tests for the fairshare simulator (ref
+``cmd/fairshare-simulator`` README example + time-based k term)."""
+from fairshare_simulator import simulate
+
+
+def _req(queues, total_gpu=100, k=None):
+    req = {"totalResource": {"GPU": total_gpu, "CPU": 0, "Memory": 0},
+           "queues": queues}
+    if k is not None:
+        req["kValue"] = k
+    return req
+
+
+def _q(uid, deserved=10, request=100, weight=1.0, priority=0, usage=0.0,
+       max_allowed=-1):
+    return {"uid": uid, "priority": priority,
+            "resourceShare": {"gpu": {
+                "deserved": deserved, "request": request,
+                "overQuotaWeight": weight, "usage": usage,
+                "maxAllowed": max_allowed}}}
+
+
+def test_readme_example_split():
+    out = simulate(_req([_q("q1", weight=3), _q("q2", weight=1)]))
+    assert out["q1"]["gpu"] == 70.0
+    assert out["q2"]["gpu"] == 30.0
+
+
+def test_deserved_capped_by_request():
+    out = simulate(_req([_q("q1", deserved=50, request=20),
+                         _q("q2", deserved=10, request=100)]))
+    assert out["q1"]["gpu"] == 20.0
+    assert out["q2"]["gpu"] == 80.0
+
+
+def test_max_allowed_caps_fair_share():
+    out = simulate(_req([_q("q1", max_allowed=25), _q("q2")]))
+    assert out["q1"]["gpu"] == 25.0
+    assert out["q2"]["gpu"] == 75.0
+
+
+def test_priority_tier_first():
+    out = simulate(_req([_q("hi", priority=10, request=80),
+                         _q("lo", priority=0, request=100)]))
+    # hi's tier drains first: deserved 10 + surplus up to its request
+    assert out["hi"]["gpu"] == 80.0
+    assert out["lo"]["gpu"] == 20.0
+
+
+def test_k_value_usage_shrinks_share():
+    base = simulate(_req([_q("a", deserved=0, usage=0.5),
+                          _q("b", deserved=0, usage=0.0)], k=0.0))
+    skew = simulate(_req([_q("a", deserved=0, usage=0.5),
+                          _q("b", deserved=0, usage=0.0)], k=2.0))
+    assert abs(base["a"]["gpu"] - base["b"]["gpu"]) <= 1.0
+    assert skew["a"]["gpu"] < skew["b"]["gpu"] - 1.0

@@ -1,0 +1,150 @@
+"""Shard partitioning, plugin-tier config, operator assembly, and
+node-scale-adjuster tests (ref SchedulingShard CRD semantics,
+plugins/factory.go tiers, pkg/operator, pkg/nodescaleadjuster)."""
+import numpy as np
+
+from kai_scheduler_tpu import plugins
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.controllers.nodescale_adjuster import (SCALING_GROUP,
+                                                              ScaleAdjuster)
+from kai_scheduler_tpu.framework.scheduler import Scheduler, SchedulerConfig
+from kai_scheduler_tpu.operator import Operator
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+POOL = apis.NODE_POOL_LABEL_KEY
+
+
+def _partitioned_cluster():
+    nodes = [
+        apis.Node("na", apis.ResourceVec(8, 64, 256), labels={POOL: "a"}),
+        apis.Node("nb", apis.ResourceVec(8, 64, 256), labels={POOL: "b"}),
+    ]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups = [
+        apis.PodGroup("ga", queue="q", min_member=1, labels={POOL: "a"}),
+        apis.PodGroup("gb", queue="q", min_member=1, labels={POOL: "b"}),
+    ]
+    pods = [apis.Pod("pa", "ga", apis.ResourceVec(1, 1, 1)),
+            apis.Pod("pb", "gb", apis.ResourceVec(1, 1, 1))]
+    return Cluster.from_objects(nodes, queues, groups, pods)
+
+
+def test_shards_schedule_disjoint_partitions():
+    cluster = _partitioned_cluster()
+    shard_a = Scheduler(SchedulerConfig(
+        shard=apis.SchedulingShard("a", partition_label_value="a")))
+    shard_b = Scheduler(SchedulerConfig(
+        shard=apis.SchedulingShard("b", partition_label_value="b")))
+    ra = shard_a.run_once(cluster)
+    rb = shard_b.run_once(cluster)
+    assert [(b.pod_name, b.selected_node) for b in ra.bind_requests] == \
+        [("pa", "na")]
+    assert [(b.pod_name, b.selected_node) for b in rb.bind_requests] == \
+        [("pb", "nb")]
+
+
+def test_default_shard_takes_unlabeled_objects():
+    cluster = _partitioned_cluster()
+    cluster.nodes["nu"] = apis.Node("nu", apis.ResourceVec(8, 64, 256))
+    cluster.pod_groups["gu"] = apis.PodGroup("gu", queue="q", min_member=1)
+    cluster.pods["pu"] = apis.Pod("pu", "gu", apis.ResourceVec(1, 1, 1))
+    default = Scheduler(SchedulerConfig(shard=apis.SchedulingShard()))
+    r = default.run_once(cluster)
+    assert [(b.pod_name, b.selected_node) for b in r.bind_requests] == \
+        [("pu", "nu")]
+
+
+def test_plugin_tiers_config_string():
+    assert plugins.parse_tiers("nodeplacement,resourcetype") == (
+        "nodeplacement", "resourcetype")
+    assert set(plugins.available_plugins()) >= {
+        "nodeplacement", "resourcetype", "nodeavailability"}
+    try:
+        plugins.resolve(("nope",))
+        raise AssertionError("unknown plugin must raise")
+    except KeyError:
+        pass
+
+
+def test_disabling_availability_plugin_changes_scoring():
+    """With nodeavailability disabled, a task no longer prefers the
+    idle-fitting node over one that only fits on releasing capacity."""
+    from kai_scheduler_tpu.framework.session import SessionConfig
+    from kai_scheduler_tpu.ops.allocate import AllocateConfig
+    from kai_scheduler_tpu.ops.scoring import PlacementConfig
+
+    nodes = [apis.Node("idle", apis.ResourceVec(4, 64, 256)),
+             apis.Node("busy", apis.ResourceVec(2, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=10))]
+    groups = [apis.PodGroup("old", queue="q", min_member=1,
+                            last_start_timestamp=0.0),
+              apis.PodGroup("new", queue="q", min_member=1)]
+    pods = [apis.Pod("vic", "old", apis.ResourceVec(2, 1, 1),
+                     status=apis.PodStatus.RELEASING, node="busy"),
+            apis.Pod("inc", "new", apis.ResourceVec(1, 1, 1))]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+
+    def run(tiers):
+        cfg = SchedulerConfig(session=SessionConfig(
+            allocate=AllocateConfig(placement=PlacementConfig(tiers=tiers))))
+        res = Scheduler(cfg).run_once(cluster)
+        pl = {b.pod_name: b.selected_node for b in res.bind_requests}
+        for br in list(cluster.bind_requests):
+            del cluster.bind_requests[br]
+        return pl
+
+    with_avail = run(("nodeplacement", "resourcetype", "nodeavailability"))
+    # availability band (100) dominates binpack (<=9): picks the idle node
+    assert with_avail.get("inc") == "idle"
+    without = run(("nodeplacement", "resourcetype"))
+    # binpack alone prefers the fuller (releasing) node — and without the
+    # availability band the task pipelines there instead of binding now
+    assert "inc" not in without
+
+
+def test_operator_builds_shard_schedulers_and_runs():
+    cluster = _partitioned_cluster()
+    config = apis.Config(shards=[
+        apis.SchedulingShard("a", partition_label_value="a"),
+        apis.SchedulingShard("b", partition_label_value="b"),
+    ])
+    op = Operator(config=config, cluster=cluster)
+    assert set(op.schedulers) == {"a", "b"}
+    results = op.run_cycle()
+    bound = {p.name for p in cluster.pods.values()
+             if p.status == apis.PodStatus.BOUND}
+    assert bound == {"pa", "pb"}
+    assert set(results) == {"a", "b"}
+
+    # dropping a shard from the config removes its scheduler
+    op.config = apis.Config(shards=[
+        apis.SchedulingShard("a", partition_label_value="a")])
+    op.reconcile()
+    assert set(op.schedulers) == {"a"}
+
+
+def test_scale_adjuster_creates_and_deletes_scaling_pods():
+    nodes = [apis.Node("n0", apis.ResourceVec(0, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+    groups = [apis.PodGroup("g", queue="q", min_member=1, fit_failures=1)]
+    pods = [apis.Pod("frac", "g", apis.ResourceVec(0.5, 1, 1),
+                     accel_portion=0.5)]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    adj = ScaleAdjuster(cool_down_s=30.0)
+    out = adj.adjust(cluster)
+    assert out["created"] == ["scaling-pod-frac"]
+    scaling = cluster.pods["scaling-pod-frac"]
+    assert scaling.group == SCALING_GROUP
+    assert scaling.resources.accel == 1.0  # ceil(0.5 portion) whole device
+
+    # scheduler snapshots must not see scaling pods
+    from kai_scheduler_tpu.state import build_snapshot
+    state, idx = build_snapshot(*cluster.snapshot_lists())
+    assert all(n is None or not n.startswith("scaling-pod-")
+               for row in idx.task_names for n in row)
+
+    # trigger pod schedules -> scaling pod cleaned up
+    cluster.pod_groups["g"].fit_failures = 0
+    pods[0].status = apis.PodStatus.BOUND
+    out2 = adj.adjust(cluster)
+    assert out2["deleted"] == ["scaling-pod-frac"]

@@ -1,0 +1,138 @@
+"""Time-based fairshare tests — ref ``cache/usagedb`` + the env-test
+shapes in ``pkg/env-tests/time_aware_fairness_test.go``: historical
+usage shrinks a greedy queue's over-quota fair share via the k term."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler, SchedulerConfig
+from kai_scheduler_tpu.framework.session import SessionConfig
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.runtime.usagedb import (UsageLister, UsageParams,
+                                               cluster_allocation_client,
+                                               cluster_capacity_fn)
+from kai_scheduler_tpu.state import build_snapshot
+
+R = apis.NUM_RESOURCES
+
+
+def test_sliding_window_decay_and_normalization():
+    alloc = {"qa": np.array([4.0, 0.0, 0.0])}
+    lister = UsageLister(
+        client=lambda now: alloc,
+        params=UsageParams(half_life_s=100.0, fetch_interval_s=10.0),
+        capacity_fn=lambda now: np.array([8.0, 0.0, 0.0]))
+    for t in range(0, 101, 10):
+        lister.fetch(float(t))
+    usage = lister.queue_usage(100.0)
+    # constant 4-of-8 allocation => normalized usage approaches 0.5
+    assert usage is not None
+    assert abs(float(usage["qa"][0]) - 0.5) < 1e-6
+
+    # stop allocating: usage decays toward 0 while capacity keeps
+    # integrating, so the normalized share shrinks
+    alloc.clear()
+    for t in range(110, 400, 10):
+        lister.fetch(float(t))
+    late = lister.queue_usage(390.0)
+    assert float(late["qa"][0]) < 0.2
+
+
+def test_staleness_rejects_old_data():
+    lister = UsageLister(
+        client=lambda now: {"qa": np.array([1.0, 0.0, 0.0])},
+        params=UsageParams(fetch_interval_s=10.0, staleness_period_s=30.0),
+        capacity_fn=lambda now: np.array([8.0, 0.0, 0.0]))
+    lister.fetch(0.0)
+    lister.fetch(10.0)
+    assert lister.queue_usage(20.0) is not None
+    assert lister.queue_usage(50.0) is None  # > 30s since last data
+
+
+def test_tumbling_window_resets():
+    lister = UsageLister(
+        client=lambda now: {"qa": np.array([4.0, 0.0, 0.0])},
+        params=UsageParams(window_type="tumbling", tumbling_window_s=100.0,
+                           fetch_interval_s=10.0),
+        capacity_fn=lambda now: np.array([8.0, 0.0, 0.0]))
+    for t in range(0, 100, 10):
+        lister.fetch(float(t))
+    before = float(lister.queue_usage(90.0)["qa"][0])
+    lister.fetch(105.0)  # crosses the boundary: accumulator resets
+    lister.fetch(110.0)
+    after = float(lister.queue_usage(110.0)["qa"][0])
+    assert before > 0.4
+    # after the reset only one 5s interval is integrated
+    assert after <= before
+
+
+def _two_queue_state(usage_a: float, k_value: float):
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 640, 2560))]
+    queues = [
+        apis.Queue("qa", accel=apis.QueueResource(quota=0.0,
+                                                  over_quota_weight=1.0)),
+        apis.Queue("qb", accel=apis.QueueResource(quota=0.0,
+                                                  over_quota_weight=1.0)),
+    ]
+    groups = [apis.PodGroup(f"g{q}", queue=q, min_member=1)
+              for q in ("qa", "qb")]
+    pods = [apis.Pod(f"p{q}-{i}", f"g{q}", apis.ResourceVec(1, 1, 1))
+            for q in ("qa", "qb") for i in range(8)]
+    usage = {"qa": np.array([usage_a, 0.0, 0.0], np.float32)}
+    state, _ = build_snapshot(nodes, queues, groups, pods,
+                              queue_usage=usage)
+    fs = drf.set_fair_share(state, num_levels=1, k_value=k_value)
+    return np.asarray(fs)
+
+
+def test_usage_shrinks_fair_share_with_k():
+    """Equal-weight queues, queue A historically used half the cluster:
+    with k>0 its fair share drops below B's; with k=0 they split evenly."""
+    fs_k0 = _two_queue_state(usage_a=0.5, k_value=0.0)
+    assert abs(fs_k0[0, 0] - fs_k0[1, 0]) <= 1.0  # even split (± rounding)
+    fs_k2 = _two_queue_state(usage_a=0.5, k_value=2.0)
+    assert fs_k2[0, 0] < fs_k2[1, 0] - 1.0
+
+
+def test_scheduler_threads_usage_end_to_end():
+    """Scheduler + UsageLister: after queue A hogs the cluster for a
+    while, a contended re-schedule gives B the larger share."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 640, 2560))]
+    queues = [
+        apis.Queue("qa", accel=apis.QueueResource(quota=0.0,
+                                                  over_quota_weight=1.0)),
+        apis.Queue("qb", accel=apis.QueueResource(quota=0.0,
+                                                  over_quota_weight=1.0)),
+    ]
+    # phase 1: only A's workload exists and takes the whole cluster
+    ga = apis.PodGroup("ga", queue="qa", min_member=1)
+    pods_a = [apis.Pod(f"pa{i}", "ga", apis.ResourceVec(1, 1, 1))
+              for i in range(8)]
+    cluster = Cluster.from_objects(nodes, queues, [ga], pods_a)
+    lister = UsageLister(cluster_allocation_client(cluster),
+                         UsageParams(half_life_s=1000.0,
+                                     fetch_interval_s=10.0),
+                         capacity_fn=cluster_capacity_fn(cluster))
+    sched = Scheduler(SchedulerConfig(
+        session=SessionConfig(k_value=2.0)), usage_lister=lister)
+    res = sched.run_once(cluster)
+    for br in res.bind_requests:
+        cluster.bind_pod(br.pod_name, br.selected_node)
+    for t in range(0, 200, 10):
+        cluster.tick(10.0)
+        lister.maybe_fetch(cluster.now)
+    # phase 2: A's pods finish; both queues now submit 8 pods each
+    for p in list(cluster.pods.values()):
+        p.status = apis.PodStatus.RELEASING
+    cluster.tick(1.0)
+    cluster.submit(apis.PodGroup("ga2", queue="qa", min_member=1),
+                   [apis.Pod(f"pa2-{i}", "ga2", apis.ResourceVec(1, 1, 1))
+                    for i in range(8)])
+    cluster.submit(apis.PodGroup("gb", queue="qb", min_member=1),
+                   [apis.Pod(f"pb{i}", "gb", apis.ResourceVec(1, 1, 1))
+                    for i in range(8)])
+    res2 = sched.run_once(cluster)
+    placed = {"qa": 0, "qb": 0}
+    for br in res2.bind_requests:
+        placed["qa" if br.pod_name.startswith("pa") else "qb"] += 1
+    assert placed["qb"] > placed["qa"], placed
