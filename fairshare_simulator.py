@@ -82,6 +82,8 @@ def simulate(request: dict) -> dict:
         creation_order=jnp.arange(Q, dtype=jnp.int32),
         preempt_min_runtime=jnp.zeros((Q,), jnp.float32),
         reclaim_min_runtime=jnp.zeros((Q,), jnp.float32),
+        preempt_min_runtime_eff=jnp.zeros((Q,), jnp.float32),
+        reclaim_min_runtime_eff=jnp.zeros((Q, Q), jnp.float32),
     )
     seg_total = jnp.concatenate(
         [jnp.asarray(total)[None, :], jnp.zeros((Q, 3), jnp.float32)],

@@ -1,0 +1,142 @@
+"""Scheduler sidecar server — the PluginServer + the snapshot-in /
+placements-out wire boundary.
+
+Three reference surfaces collapse into one stdlib HTTP server:
+
+- ``GET /job-order``  — the reflectjoborder plugin
+  (``plugins/reflectjoborder``): the computed job order of the last (or
+  an on-demand) session, for debugging fairness.
+- ``GET /snapshot``   — the snapshot plugin (``plugins/snapshot``):
+  the full cluster state as JSON, replayable by ``snapshot_tool.py``.
+- ``POST /cycle``     — the sidecar protocol (SURVEY.md §7d): POST a
+  cluster snapshot document, receive the cycle's commit set.  This is
+  the cache→session boundary as a wire protocol, so a host harness in
+  another language can mount the TPU solver behind its own registries.
+- ``GET /metrics``    — Prometheus text exposition
+  (``pkg/scheduler/metrics``).
+
+The server is deliberately dependency-free (http.server); a production
+deployment would front it with gRPC — the payloads are already the
+stable JSON documents of ``runtime/snapshot.py``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..runtime.cluster import Cluster
+from ..runtime.snapshot import dump_cluster, load_cluster
+from . import metrics
+from .scheduler import Scheduler
+from .session import Session
+
+
+def job_order(cluster: Cluster, scheduler: Scheduler) -> list[dict]:
+    """The fairness-ordered gang list a cycle would attempt —
+    reflectjoborder's payload."""
+    from ..ops import ordering
+    session = Session.open(*cluster.snapshot_lists(),
+                           config=scheduler.config.session,
+                           now=cluster.now)
+    st = session.state
+    perm = np.asarray(ordering.job_order_perm(
+        st.gangs, st.queues, st.queues.allocated, st.queues.fair_share,
+        st.total_capacity, st.gangs.valid))
+    valid = np.asarray(st.gangs.valid)
+    queues = np.asarray(st.gangs.queue)
+    out = []
+    for gi in perm.tolist():
+        if gi < len(session.index.gang_names) and valid[gi]:
+            out.append({
+                "pod_group": session.index.gang_names[gi],
+                "queue": session.index.queue_names[queues[gi]],
+            })
+    return out
+
+
+def run_cycle_doc(doc: dict, scheduler: Scheduler | None = None) -> dict:
+    """POST /cycle body → commit-set document (the sidecar protocol)."""
+    cluster = load_cluster(doc)
+    scheduler = scheduler or Scheduler()
+    result = scheduler.run_once(cluster)
+    return {
+        "bind_requests": [{
+            "pod": br.pod_name, "node": br.selected_node,
+            "type": br.received_resource_type.value,
+            "accel_count": br.received_accel_count,
+            "accel_portion": br.received_accel_portion,
+            "accel_memory_gib": br.received_accel_memory_gib,
+            "accel_groups": br.selected_accel_groups,
+        } for br in result.bind_requests],
+        "evictions": [{
+            "pod": ev.pod_name, "group": ev.group, "move_to": ev.move_to,
+        } for ev in result.evictions],
+        "action_seconds": result.action_seconds,
+    }
+
+
+class SchedulerServer:
+    """Serve the debug/sidecar endpoints for one cluster + scheduler."""
+
+    def __init__(self, cluster: Cluster, scheduler: Scheduler | None = None,
+                 port: int = 0):
+        self.cluster = cluster
+        self.scheduler = scheduler or Scheduler()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, payload, code=200):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/job-order":
+                    self._send(job_order(outer.cluster, outer.scheduler))
+                elif self.path == "/snapshot":
+                    self._send(dump_cluster(outer.cluster))
+                elif self.path == "/metrics":
+                    body = metrics.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/cycle":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    doc = json.loads(self.rfile.read(length).decode())
+                    self._send(run_cycle_doc(doc, outer.scheduler))
+                except Exception as exc:  # noqa: BLE001
+                    self.send_error(400, str(exc))
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "SchedulerServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

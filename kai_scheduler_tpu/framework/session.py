@@ -73,20 +73,18 @@ class Session:
         if config.auto_tune:
             devices = index.needs_device_table
             uniform = index.uniform_gangs and not devices
-            topo = index.has_required_topology
-            sub_topo = index.has_subgroup_topology
+            sub_topo = (index.has_subgroup_topology
+                        or index.has_required_topology)
             config = dataclasses.replace(
                 config,
                 allocate=dataclasses.replace(
                     config.allocate, track_devices=devices,
-                    uniform_tasks=uniform, topology=topo,
-                    subgroup_topology=sub_topo),
+                    uniform_tasks=uniform, subgroup_topology=sub_topo),
                 victims=dataclasses.replace(
                     config.victims,
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
-                        uniform_tasks=uniform, topology=topo,
-                        subgroup_topology=sub_topo)))
+                        uniform_tasks=uniform, subgroup_topology=sub_topo)))
         fair_share = drf.set_fair_share(
             state, num_levels=config.num_levels, k_value=config.k_value)
         state = state.replace(queues=state.queues.replace(fair_share=fair_share))
@@ -111,30 +109,33 @@ class Session:
         portions = np.asarray(self.state.gangs.task_portion)
         mems = np.asarray(self.state.gangs.task_accel_mem)
         reqs = np.asarray(self.state.gangs.task_req)
+        # one vectorized selection, then O(placements) object building —
+        # never an O(G x T) Python scan
+        sel = allocated[:, None] & (placements >= 0) & ~pipelined
         out: list[apis.BindRequest] = []
-        for gi, gang_name in enumerate(self.index.gang_names):
-            if not allocated[gi]:
+        ngangs = len(self.index.gang_names)
+        for gi, ti in zip(*(idx.tolist() for idx in np.nonzero(sel))):
+            if gi >= ngangs:
                 continue
-            for ti, pod_name in enumerate(self.index.task_names[gi]):
-                node = int(placements[gi, ti])
-                if pod_name is None or node < 0 or pipelined[gi, ti]:
-                    continue
-                portion = float(portions[gi, ti])
-                is_frac = portion > 0 or mems[gi, ti] > 0
-                dev = int(devices[gi, ti])
-                out.append(apis.BindRequest(
-                    pod_name=pod_name,
-                    selected_node=self.index.node_names[node],
-                    received_resource_type=(
-                        apis.ReceivedResourceType.FRACTION if is_frac
-                        else apis.ReceivedResourceType.REGULAR),
-                    received_accel_portion=portion,
-                    received_accel_memory_gib=float(mems[gi, ti]),
-                    received_accel_count=(
-                        0 if is_frac else int(round(float(reqs[gi, ti, 0])))),
-                    selected_accel_groups=[dev] if dev >= 0 else [],
-                    backoff_limit=self.config.default_bind_backoff_limit,
-                ))
+            pod_name = self.index.task_names[gi][ti]
+            if pod_name is None:
+                continue
+            portion = float(portions[gi, ti])
+            is_frac = portion > 0 or mems[gi, ti] > 0
+            dev = int(devices[gi, ti])
+            out.append(apis.BindRequest(
+                pod_name=pod_name,
+                selected_node=self.index.node_names[int(placements[gi, ti])],
+                received_resource_type=(
+                    apis.ReceivedResourceType.FRACTION if is_frac
+                    else apis.ReceivedResourceType.REGULAR),
+                received_accel_portion=portion,
+                received_accel_memory_gib=float(mems[gi, ti]),
+                received_accel_count=(
+                    0 if is_frac else int(round(float(reqs[gi, ti, 0])))),
+                selected_accel_groups=[dev] if dev >= 0 else [],
+                backoff_limit=self.config.default_bind_backoff_limit,
+            ))
         return out
 
     def evictions_from(self, victim_mask,
@@ -149,15 +150,21 @@ class Session:
         moves = None if victim_move is None else np.asarray(victim_move)
         gangs = np.asarray(self.state.running.gang)
         out: list[apis.Eviction] = []
-        for mi, name in enumerate(self.index.running_pod_names):
-            if mi < len(mask) and mask[mi] and name:
-                gi = int(gangs[mi])
-                group = self.index.gang_names[gi] if 0 <= gi < len(self.index.gang_names) else ""
-                move_to = None
-                if moves is not None and mi < len(moves) and moves[mi] >= 0:
-                    move_to = self.index.node_names[int(moves[mi])]
-                out.append(apis.Eviction(pod_name=name, group=group,
-                                         move_to=move_to))
+        nnames = len(self.index.running_pod_names)
+        for mi in np.nonzero(mask)[0].tolist():
+            if mi >= nnames:
+                continue
+            name = self.index.running_pod_names[mi]
+            if not name:
+                continue
+            gi = int(gangs[mi])
+            group = (self.index.gang_names[gi]
+                     if 0 <= gi < len(self.index.gang_names) else "")
+            move_to = None
+            if moves is not None and mi < len(moves) and moves[mi] >= 0:
+                move_to = self.index.node_names[int(moves[mi])]
+            out.append(apis.Eviction(pod_name=name, group=group,
+                                     move_to=move_to))
         return out
 
     #: fit_reason code → message (ref ``api/unschedule_info.go`` fit errors)

@@ -192,7 +192,8 @@ class AllocateConfig:
     #: (nodes, devices, queue caps).  Conflict-rejected gangs retry next
     #: chunk, so capacity semantics are exact; only the scoring heuristic
     #: sees ≤1 chunk of staleness.  1 = fully sequential (reference-exact).
-    batch_size: int = 64
+    #: 256 measured fastest at the 10k-node × 50k-pod baseline scale.
+    batch_size: int = 256
     #: maintain the per-device share table.  Set False when the snapshot
     #: holds no fractional/memory-based tasks — the node-level accel
     #: vector is then exact and the device-granular bookkeeping (the
@@ -210,16 +211,11 @@ class AllocateConfig:
     #: with no feasible nodes for ``min_needed`` tasks are never attempted
     #: (ref ``actions/common/feasible_nodes.go:11`` FeasibleNodesForJob)
     prefilter: bool = True
-    #: compile the required-level topology domain loop.  False when the
-    #: snapshot holds no topology-required gang — lax.cond compiles BOTH
-    #: branches, and the domain loop embeds a second copy of the task
-    #: kernel, so skipping it roughly halves compile time.  Session
-    #: derives this from the snapshot automatically.
-    topology: bool = True
-    #: compile the per-SUBGROUP required-level machinery (domain locks +
-    #: capacity-aware first placement, an O(N) segment reduction per task
-    #: step).  False when no gang declares subgroup topology constraints.
-    #: Session derives this from the snapshot automatically.
+    #: compile the required-level machinery (per-subgroup domain locks +
+    #: capacity-aware, domain-binpacked first placement — gang-level
+    #: required levels route through subgroup slot 0).  An O(N) segment
+    #: reduction per task step; False when the snapshot holds no required
+    #: topology constraint.  Session derives this automatically.
     subgroup_topology: bool = True
     #: skip gangs whose scheduling signature already failed this action —
     #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
@@ -267,6 +263,7 @@ def _attempt_gang_in_domain(
     D = n.d
     N = n.n
     L = n.topology.shape[1]
+    R_DIM = free.shape[1]
     task_req = g.task_req[gang_idx]          # [T, R]
     task_valid = g.task_valid[gang_idx]      # [T]
     task_sel = g.task_selector[gang_idx]     # [T, K]
@@ -346,11 +343,21 @@ def _attempt_gang_in_domain(
         jnp.where((eligible_new if not legacy else task_valid)[:, None],
                   task_req, 0.0),
         sub, num_segments=S)                                            # [S, R]
-
-    # cyclic per-lane rotation, scaled well below the 1.0-resolution of
-    # the score bands (density scores quantize coarsely on equal nodes)
-    tie_jitter = (-1e-4 / N) * jnp.mod(
-        jnp.arange(N) - lane, N).astype(jnp.float32)           # [N]
+    # per-domain aggregate availability over the GLOBAL dense domain-id
+    # space (all levels share it), computed once per attempt and
+    # maintained incrementally — a per-task-step segment reduction blew
+    # TPU scratch limits at wavefront width
+    ND = N * L
+    if config.subgroup_topology:
+        avail0 = free + n.releasing + extra_releasing                   # [N, R]
+        agg0 = jnp.zeros((ND + 1, R_DIM), avail0.dtype)
+        for lvl in range(L):
+            ids = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
+                            n.topology[:, lvl], ND)
+            agg0 = agg0.at[ids].add(jnp.where(n.valid[:, None], avail0,
+                                              0.0))
+    else:
+        agg0 = jnp.zeros((1, R_DIM), free.dtype)
 
     # Queue capacity gates (capacity_policy.go:26-50), hoisted out of the
     # task loop: all tasks of a gang share one queue chain, so the gate
@@ -375,11 +382,9 @@ def _attempt_gang_in_domain(
         | exempt, axis=(1, 2))
     gate_t = gate_lim & jnp.where(nonpreempt, gate_quota, True)  # [T]
 
-    ND = N * L
-
     def task_body(t, carry):
         (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom, sub_rem,
-         nodes_t, dev_t, pipe_t, count, q_delta, pref_dom) = carry
+         agg, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom) = carry
         req = task_req[t]
         is_frac = (task_portion[t] > 0) | (task_mem[t] > 0)
         ok = eligible_t[t] & gate_t[t]
@@ -393,15 +398,20 @@ def _attempt_gang_in_domain(
             task_class=task_class[t])
         allowed = domain_mask & ~forbidden
         # per-subgroup required level: once the subgroup's first task
-        # lands, its whole domain at that level is locked for the rest
-        # (greedy domain choice; gang-level domains retry via the outer
-        # domain loop) — ref allocateSubGroupSet per-subgroup subsets
+        # lands, its whole domain at that level is locked for the rest.
+        # The pick is greedy and single-shot — the aggregate-capacity
+        # gate (dom_ok below) stands in for allocateSubGroupSet's
+        # per-subset rollback search, so a domain whose aggregate fits
+        # but is fragmented across nodes can still fail the attempt
+        # (retried next cycle); the whole-gang kernel's per-node replica
+        # counts are fragmentation-exact for uniform gangs.
         s_t = sub[t]
         level_t = srl[s_t]
         has_srl = level_t >= 0
         dom_col = jnp.take(n.topology, jnp.clip(level_t, 0, L - 1),
                            axis=1)                                     # [N]
         locked = sub_dom[s_t]
+        dom_band = jnp.zeros((N,), jnp.float32)
         if config.subgroup_topology:
             allowed = allowed & (
                 ~has_srl | (locked < 0) | (dom_col == locked))
@@ -409,16 +419,20 @@ def _attempt_gang_in_domain(
             # whose aggregate capacity still fits the subgroup's
             # remaining chunk, or the lock would doom the attempt
             needs_pick = has_srl & (locked < 0)
-            avail_pipe = free_l + n.releasing + extra_releasing        # [N, R]
-            dom_seg = jnp.where(n.valid & (dom_col >= 0), dom_col, ND)
-            agg = jax.ops.segment_sum(
-                jnp.where(n.valid[:, None], avail_pipe, 0.0), dom_seg,
-                num_segments=ND + 1)[:ND]                              # [ND, R]
+            node_agg = agg[jnp.maximum(dom_col, 0)]                    # [N, R]
             dom_ok = jnp.all(
-                agg[jnp.maximum(dom_col, 0)] + EPS
-                >= sub_rem[s_t][None, :],
+                node_agg + EPS >= sub_rem[s_t][None, :],
                 axis=-1) & (dom_col >= 0)
             allowed = allowed & (~needs_pick | dom_ok)
+            # binpack the domain choice: fullest fitting domain first
+            # (ref topology/node_scoring.go domain ordering) — scaled
+            # into the topology band so node-level bands stay subordinate
+            agg_accel = node_agg[:, 0]
+            mx = jnp.max(jnp.where(dom_ok, agg_accel, 0.0))
+            dom_band = jnp.where(
+                needs_pick & dom_ok,
+                W_TOPOLOGY * (1.0 - agg_accel / jnp.maximum(mx, EPS)),
+                0.0)
         fit_idle = fit_idle & allowed
         fit_pipe = fit_pipe & allowed                                  # [N]
         # preferred-level locality band (topology plugin node scoring):
@@ -426,9 +440,19 @@ def _attempt_gang_in_domain(
         topo_band = jnp.where(
             has_pref & (pref_dom >= 0) & (pref_doms == pref_dom),
             W_TOPOLOGY, 0.0)                                           # [N]
+        # per-lane tie-break by rank WITHIN the feasible set: equal-scoring
+        # nodes spread across wavefront lanes even when feasibility is
+        # confined to a small domain (an absolute-index rotation would
+        # collapse every lane onto the same first feasible node there,
+        # serializing the chunk to one accepted gang)
+        rank_feas = jnp.cumsum(fit_pipe.astype(jnp.int32)) - 1
+        tie_jitter = (-1e-4 / N) * jnp.mod(rank_feas - lane, N).astype(
+            jnp.float32)                                               # [N]
         # soft filter bands (PreferNoSchedule / preferred pod-affinity)
-        # + the nominatednode plugin's dominating bonus
-        extra_bands = (topo_band + tie_jitter + n.soft_scores[task_class[t]]
+        # + the nominatednode plugin's dominating bonus + the required-
+        # domain binpack band
+        extra_bands = (topo_band + dom_band + tie_jitter
+                       + n.soft_scores[task_class[t]]
                        + jnp.where(jnp.arange(N) == task_nom[t],
                                    W_NOMINATED, 0.0))
         if config.track_devices:
@@ -496,6 +520,13 @@ def _attempt_gang_in_domain(
             jnp.where(placed & has_srl & (locked < 0), dom_col[node],
                       locked))
         sub_rem = sub_rem.at[s_t].add(-jnp.where(placed, req, 0.0))
+        if config.subgroup_topology:
+            # keep the per-domain aggregate current: the chosen node's
+            # domain at EVERY level loses this placement
+            for lvl in range(L):
+                did = n.topology[node, lvl]
+                agg = agg.at[jnp.where(did >= 0, did, ND)].add(
+                    -jnp.where(placed, delta_node, 0.0))
         nodes_t = nodes_t.at[t].set(jnp.where(placed, node, -1))
         dev_t = dev_t.at[t].set(
             jnp.where(placed & is_frac, frac_dev, -1))
@@ -504,7 +535,8 @@ def _attempt_gang_in_domain(
         pref_dom = jnp.where(placed & (pref_dom < 0), pref_doms[node],
                              pref_dom)
         return (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom,
-                sub_rem, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom)
+                sub_rem, agg, nodes_t, dev_t, pipe_t, count, q_delta,
+                pref_dom)
 
     # seed subgroup domain locks from prior placements
     prior_level = srl[sub]                                              # [T]
@@ -515,7 +547,7 @@ def _attempt_gang_in_domain(
 
     carry = (free, device_free,
              jnp.zeros_like(free), jnp.zeros_like(device_free),
-             forbidden0, sub_dom0, sub_rem0,
+             forbidden0, sub_dom0, sub_rem0, agg0,
              jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
              jnp.zeros((T,), bool),
              jnp.asarray(0, jnp.int32), jnp.zeros_like(task_req[0]),
@@ -525,7 +557,7 @@ def _attempt_gang_in_domain(
     # victim solver) — unrolling T copies made compile time the suite's
     # bottleneck while saving only ~µs of loop overhead per step
     carry = lax.fori_loop(0, T, task_body, carry)
-    (free2, dev2, bind_used, dev_bind, _, _, _, nodes_t, dev_t, pipe_t,
+    (free2, dev2, bind_used, dev_bind, _, _, _, _, nodes_t, dev_t, pipe_t,
      count, q_delta, _) = carry
     # queue accounting applied once for the whole gang along its chain
     qa2 = q_alloc + anc[:, None] * q_delta[None, :]
@@ -592,9 +624,6 @@ def _attempt_gang_in_domain_uniform(
     prior_on_node = jnp.zeros((N,), jnp.int32).at[
         jnp.maximum(prior_nodes, 0)].add(already.astype(jnp.int32)) > 0
 
-    tie_jitter = (-1e-4 / N) * jnp.mod(
-        jnp.arange(N) - lane, N).astype(jnp.float32)    # [N]
-
     # ---- queue capacity gate: max replicas within every ancestor cap ----
     limit_eff = jnp.where(state.queues.limit <= UNLIMITED + 0.5,
                           jnp.inf, state.queues.limit)
@@ -638,7 +667,53 @@ def _attempt_gang_in_domain_uniform(
         return jnp.where(one_per_node, jnp.minimum(c, 1), c)
 
     c_pipe = copies(free + n.releasing + extra_releasing, fit_pipe)  # [N]
+
+    if config.subgroup_topology:
+        # required topology level (gang-level routes through subgroup
+        # slot 0): choose ONE domain that can host the whole chunk —
+        # fullest fitting first (ref topology domain binpack) — and
+        # confine the fill to it.  Re-push attempts stay in the domain
+        # the quorum locked.
+        L = n.topology.shape[1]
+        srl0 = g.subgroup_required_level[gang_idx, 0]
+        has_req = srl0 >= 0
+        dom_col = jnp.take(n.topology, jnp.clip(srl0, 0, L - 1), axis=1)
+        NDu = N * L
+        ids = jnp.where(n.valid & (dom_col >= 0), dom_col, NDu)
+        dom_caps = jax.ops.segment_sum(
+            c_pipe, ids, num_segments=NDu + 1)[:NDu]     # [ND] replicas
+        avail_accel = (free[:, 0] + n.releasing[:, 0]
+                       + extra_releasing[:, 0])
+        agg_accel = jax.ops.segment_sum(
+            jnp.where(n.valid, avail_accel, 0.0), ids,
+            num_segments=NDu + 1)[:NDu]
+        want0 = jnp.minimum(goal if not legacy else tcount, m_gate)
+        fits_dom = dom_caps >= jnp.maximum(want0, 1)
+        # spread wavefront lanes across the fitting domains, fullest
+        # first: lane 0 takes the binpack choice, lane k the k-th-fullest
+        # — otherwise every lane of a chunk fills the same domain and the
+        # accept prefix caps at one domain's capacity
+        order_dom = jnp.argsort(jnp.where(fits_dom, agg_accel, jnp.inf))
+        n_fit = jnp.sum(fits_dom.astype(jnp.int32))
+        target = order_dom[jnp.mod(lane, jnp.maximum(n_fit, 1))]
+        target = jnp.where(jnp.any(fits_dom), target, -1)
+        prior_dom = jnp.where(
+            jnp.any(already),
+            dom_col[jnp.maximum(prior_nodes[jnp.argmax(already)], 0)], -1)
+        target = jnp.where(prior_dom >= 0, prior_dom, target)
+        in_dom = ~has_req | (dom_col == target)
+        fit_idle = fit_idle & in_dom
+        fit_pipe = fit_pipe & in_dom
+        c_pipe = jnp.where(in_dom, c_pipe, 0)
+
     c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
+
+    # per-lane tie-break by rank WITHIN the feasible set (see the
+    # per-task kernel): spreads equal-scoring nodes across lanes even
+    # inside a confined required-topology domain
+    rank_feas = jnp.cumsum(fit_pipe.astype(jnp.int32)) - 1
+    tie_jitter = (-1e-4 / N) * jnp.mod(rank_feas - lane, N).astype(
+        jnp.float32)                                    # [N]
 
     # ---- scores (one pass; locality band anchored at the best node) -----
     scores0 = score_nodes_for_task(
@@ -705,18 +780,16 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
-    ``topology/job_filtering.go:34``): a gang with a *required* level is
-    attempted domain-by-domain — candidate domains at that level are
-    ordered binpack-style (least aggregate free accel first, i.e. fullest
-    domain first, ``topology/node_scoring.go``) and each attempt restricts
-    feasibility to the domain's nodes; the first succeeding domain wins
-    (checkpoint/rollback between attempts is value selection).  A
-    *preferred* level adds a locality score band instead (best-effort).
+    ``topology/job_filtering.go:34``): a *required* level — gang-level
+    levels are inherited into every subgroup slot at snapshot build — is
+    enforced by the per-subgroup domain locks inside the task kernel: the
+    subgroup's first placement picks a domain with aggregate capacity for
+    its whole chunk, binpacked fullest-first (``topology/node_scoring.go``
+    domain ordering as a score band), and the rest of the subgroup is
+    confined to it.  A *preferred* level adds a locality score band
+    instead (best-effort).
     """
     g, n = state.gangs, state.nodes
-    T = g.t
-    L = n.topology.shape[1]
-    N = n.n
     if extra_releasing is None:
         extra_releasing = jnp.zeros_like(free)
     if extra_device_releasing is None:
@@ -730,9 +803,6 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     has_pref = pl >= 0
     pref_doms = n.topology[:, jnp.maximum(pl, 0)]              # [N]
 
-    rl = g.required_level[gang_idx]
-    has_req = rl >= 0
-
     if config.uniform_tasks:
         assert not config.track_devices, \
             "uniform_tasks fast path requires track_devices=False"
@@ -740,68 +810,11 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     else:
         in_domain = _attempt_gang_in_domain
 
-    def unconstrained(_):
-        return in_domain(
-            state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-            num_levels, config, n.valid, pref_doms, has_pref,
-            extra_releasing, extra_device_releasing, lane, chain,
-            prior_nodes, quota)
-
-    if not config.topology:
-        return unconstrained(None)
-
-    def constrained(_):
-        doms = n.topology[:, jnp.maximum(rl, 0)]               # [N]
-        # domain ids are globally dense over (level, path) — bound N*L
-        D = N * L
-        dom_seg = jnp.where(n.valid & (doms >= 0), doms, D)
-        avail = free + n.releasing + extra_releasing
-        agg = jax.ops.segment_sum(
-            jnp.where(n.valid[:, None], avail, 0.0), dom_seg,
-            num_segments=D + 1)[:D]                            # [D, R]
-        has_node = jax.ops.segment_sum(
-            (n.valid & (doms >= 0)).astype(jnp.int32), dom_seg,
-            num_segments=D + 1)[:D] > 0
-        task_req = jnp.where(g.task_valid[gang_idx][:, None],
-                             g.task_req[gang_idx], 0.0)
-        total_req = task_req.sum(0)
-        fits = jnp.all(agg + EPS >= total_req[None, :], axis=-1) & has_node
-        # binpack the domain: fullest (least free accel) candidate first
-        dom_key = agg[:, 0]
-
-        empty = (free, device_free, q_alloc, q_alloc_np,
-                 jnp.full((T,), -1, jnp.int32),
-                 jnp.full((T,), -1, jnp.int32), jnp.zeros((T,), bool),
-                 jnp.asarray(False),
-                 jnp.zeros_like(free), jnp.zeros_like(device_free))
-
-        def cond(carry):
-            tried, done, _ = carry
-            # has_req in the condition matters under vmap: lax.cond
-            # becomes a select and this "dead" branch still runs for
-            # unconstrained lanes — without the guard it would iterate
-            # the domain loop for every lane of every chunk
-            return has_req & ~done & jnp.any(fits & ~tried)
-
-        def body(carry):
-            tried, _, best = carry
-            cand = fits & ~tried
-            d = jnp.argmin(jnp.where(cand, dom_key, jnp.inf))
-            out = in_domain(
-                state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-                num_levels, config, doms == d, pref_doms, has_pref,
-                extra_releasing, extra_device_releasing, lane, chain,
-                prior_nodes, quota)
-            success = out[7]
-            best = jax.tree.map(
-                lambda nw, old: jnp.where(success, nw, old), out, best)
-            return tried.at[d].set(True), success, best
-
-        _, done, best = lax.while_loop(
-            cond, body, (jnp.zeros((D,), bool), jnp.asarray(False), empty))
-        return best
-
-    return lax.cond(has_req, constrained, unconstrained, None)
+    return in_domain(
+        state, gang_idx, free, device_free, q_alloc, q_alloc_np,
+        num_levels, config, n.valid, pref_doms, has_pref,
+        extra_releasing, extra_device_releasing, lane, chain,
+        prior_nodes, quota)
 
 
 def allocate(
@@ -823,6 +836,11 @@ def allocate(
     G, T = g.g, g.t
     total = state.total_capacity
     B = max(1, min(config.batch_size, G))
+    if config.subgroup_topology and not config.uniform_tasks:
+        # the per-task kernel's domain segment reduction multiplies lane
+        # scratch by the N*L segment count; wide wavefronts exceed TPU
+        # scratch limits (observed device faults at B=256, 5k nodes)
+        B = min(B, 64)
     if init is None:
         init = init_result(state)
 
@@ -920,11 +938,11 @@ def allocate(
         quota_b = jnp.where(placed_cnt < need, need - placed_cnt, 1)
 
         # independent attempts against chunk-start state (the vmapped
-        # replacement for the reference's one-job-at-a-time hot loop)
-        # lanes start their cyclic tie-break stride-apart across the node
-        # axis so a chunk of identical gangs fans out over equal-scoring
-        # nodes instead of colliding on one
-        lanes = jnp.arange(B, dtype=jnp.int32) * max(1, n.n // B)
+        # replacement for the reference's one-job-at-a-time hot loop);
+        # each lane's feasible-rank tie-break starts at its own offset so
+        # a chunk of identical gangs fans out over equal-scoring nodes
+        # instead of colliding on one
+        lanes = jnp.arange(B, dtype=jnp.int32)
         (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
          bind_b, devbind_b) = \
             jax.vmap(attempt_one,

@@ -146,19 +146,31 @@ def victim_candidates(
     r = state.running
     g = state.gangs
     q = state.queues
+    G = g.g
     base = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
             & (r.gang >= 0) & ~already_victim)
     my_queue = g.queue[gang_idx]
+    # gang-level minruntime protection (hierarchy/LCA-resolved at
+    # snapshot build — ref plugins/minruntime/resolver.go).  A protected
+    # gang may still shed ELASTIC surplus pods; only its quorum unit is
+    # off-limits (ref reclaimFilterFn returning true for elastic jobs +
+    # the scenario validator) — enforced by the unit ranking, which gives
+    # protected gangs no whole-gang unit.
+    gang_runtime = jax.ops.segment_max(
+        jnp.where(r.valid & (r.gang >= 0), r.runtime_s, -1.0),
+        jnp.where(r.gang >= 0, r.gang, G), num_segments=G + 1)[:G]
+    gq = jnp.maximum(g.queue, 0)
     if mode == "reclaim":
-        mrt = q.reclaim_min_runtime[jnp.maximum(r.queue, 0)]
-        return base & (r.queue != my_queue) & (r.runtime_s >= mrt)
+        mrt_g = q.reclaim_min_runtime_eff[gq, my_queue]          # [G]
+    else:
+        mrt_g = q.preempt_min_runtime_eff[gq]
+    protected = (gang_runtime >= 0) & (gang_runtime < mrt_g)     # [G]
+    if mode == "reclaim":
+        return base & (r.queue != my_queue), protected
     if mode == "consolidate":
-        mrt = q.preempt_min_runtime[jnp.maximum(r.queue, 0)]
-        return base & (r.gang != gang_idx) & (r.runtime_s >= mrt)
-    mrt = q.preempt_min_runtime[jnp.maximum(r.queue, 0)]
+        return base & (r.gang != gang_idx), protected
     return (base & (r.queue == my_queue)
-            & (r.priority < g.priority[gang_idx])
-            & (r.runtime_s >= mrt))
+            & (r.priority < g.priority[gang_idx])), protected
 
 
 def _rank_eviction_units(
@@ -167,6 +179,7 @@ def _rank_eviction_units(
     queue_allocated: jax.Array,  # f32 [Q, R]
     fair_share: jax.Array,       # f32 [Q, R]
     already_victim: jax.Array,   # bool [M]  victims accumulated this cycle
+    protected: jax.Array | None = None,  # bool [G]  minruntime-protected
 ):
     """Assign every candidate pod a global eviction-unit rank.
 
@@ -228,14 +241,22 @@ def _rank_eviction_units(
     effective_active = g.running_count - victims_in_gang        # [G]
     surplus = jnp.clip(
         effective_active - g.min_member, 0, pods_per_gang)      # [G]
+    # a minruntime-protected gang keeps its quorum: it exposes only its
+    # elastic-surplus units, never the final whole-gang unit (ref the
+    # minruntime scenario validators protecting below-minAvailable)
+    whole_unit = pods_per_gang > surplus
+    if protected is not None:
+        whole_unit = whole_unit & ~protected
     units_per_gang = jnp.where(
-        victim_gang, surplus + (pods_per_gang > surplus), 0)    # [G]
+        victim_gang, surplus + whole_unit, 0)                   # [G]
     units_by_rank = units_per_gang[rank_gang]                   # [G]
     offsets = jnp.cumsum(units_by_rank) - units_by_rank         # [G] excl
-    unit_in_gang = jnp.minimum(seq, surplus[jnp.minimum(gang_of_pod, G - 1)])
+    gsafe = jnp.minimum(gang_of_pod, G - 1)
+    unit_in_gang = jnp.minimum(seq, surplus[gsafe])
+    in_range = unit_in_gang < units_per_gang[gsafe]
     unit_rank = jnp.where(
-        cand,
-        offsets[job_rank[jnp.minimum(gang_of_pod, G - 1)]] + unit_in_gang,
+        cand & in_range,
+        offsets[job_rank[gsafe]] + unit_in_gang,
         BIG)
     return unit_rank, jnp.sum(units_per_gang)
 
@@ -301,7 +322,7 @@ def solve_for_preemptor(
     else:
         gate = nonpreempt_quota_ok
 
-    cand = victim_candidates(
+    cand, protected = victim_candidates(
         state, gang_idx, mode=mode, already_victim=result.victim)
     gate &= jnp.any(cand)
 
@@ -310,7 +331,7 @@ def solve_for_preemptor(
     # effective active count for unit sizing
     removed_victims = result.victim & (result.victim_move < 0)
     unit_rank, num_units = _rank_eviction_units(
-        state, cand, qa, fair_share, removed_victims)
+        state, cand, qa, fair_share, removed_victims, protected)
     if consolidate:
         num_units = jnp.minimum(num_units,
                                 config.max_consolidation_preemptees)

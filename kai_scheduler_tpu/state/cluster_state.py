@@ -98,9 +98,15 @@ class QueueState(struct.PyTreeNode):
     creation_order: jax.Array  # i32 [Q]  tie-break (older first)
     #: minruntime protection (ref queue_types.go PreemptMinRuntime /
     #: ReclaimMinRuntime, plugins/minruntime) — seconds a job in this queue
-    #: must have run before it may be victimized.
+    #: must have run before it may be victimized.  Raw per-queue values:
     preempt_min_runtime: jax.Array  # f32 [Q]
     reclaim_min_runtime: jax.Array  # f32 [Q]
+    #: hierarchy-resolved values (ref plugins/minruntime/resolver.go):
+    #: preempt inherits up the victim's chain; reclaim resolves per
+    #: (victim leaf, reclaimer leaf) via the LCA method — the value is
+    #: inherited from the victim-side child of the LCA upward.
+    preempt_min_runtime_eff: jax.Array  # f32 [Q]
+    reclaim_min_runtime_eff: jax.Array  # f32 [Q, Q]  [victim, reclaimer]
 
     @property
     def q(self) -> int:
@@ -262,6 +268,18 @@ def _round_up(n: int, multiple: int = 8) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+#: leader-role label values — ref plugins/kubeflow (job-role master/
+#: launcher) and plugins/ray (node-type head)
+_LEADER_ROLES = ("master", "launcher", "head")
+
+
+def _task_order_key(pod: apis.Pod):
+    role = (pod.labels.get("training.kubeflow.org/job-role")
+            or pod.labels.get("ray.io/node-type"))
+    return (0 if role in _LEADER_ROLES else 1,
+            -pod.priority, pod.creation_timestamp, pod.name)
+
+
 # ---------------------------------------------------------------------------
 # Snapshot builder (host): api objects -> ClusterState
 # ---------------------------------------------------------------------------
@@ -408,6 +426,45 @@ def build_snapshot(
             d, p = d + 1, int(q_parent[p])
         q_depth[i] = d
 
+    # --- minruntime hierarchy resolution (ref plugins/minruntime) ---------
+    def _inherit(vals: np.ndarray) -> np.ndarray:
+        """First set (>0) value walking self → root; 0 when none."""
+        eff = vals.copy()
+        cur = q_parent.copy()
+        for _ in range(int(q_depth.max(initial=0)) + 1):
+            unset = (eff <= 0) & (cur >= 0)
+            if not unset.any():
+                break
+            eff[unset] = vals[cur[unset]]
+            cur = np.where(cur >= 0, q_parent[np.maximum(cur, 0)], -1)
+        return np.maximum(eff, 0.0)
+
+    q_preempt_eff = _inherit(q_preempt_mrt)
+    # ancestor-at-depth table for the LCA walk (top-level first)
+    maxd = int(q_depth.max(initial=0)) + 1
+    anc_at = np.full((Q, maxd), -1, np.int64)
+    for i in range(len(queues)):
+        chain_q, p = [i], int(q_parent[i])
+        while p >= 0:
+            chain_q.append(p)
+            p = int(q_parent[p])
+        for d, qx in enumerate(reversed(chain_q)):
+            anc_at[i, d] = qx
+    # match depth per (victim, reclaimer) pair; start queue = victim-side
+    # child of the LCA (clamped to the victim's leaf; different top-level
+    # queues degenerate to the victim's top-level queue — the "shadow
+    # parent" rule in resolver.go)
+    eq = (anc_at[:, None, :] == anc_at[None, :, :]) & (
+        anc_at[:, None, :] >= 0)                              # [Q, Q, D]
+    match_d = (eq * (np.arange(maxd) + 1)).max(axis=-1) - 1   # [Q, Q]
+    start_d = np.minimum(match_d + 1, q_depth[:, None].astype(np.int64))
+    start_q = np.take_along_axis(
+        np.broadcast_to(anc_at[:, None, :], (Q, Q, maxd)),
+        start_d[:, :, None], axis=2)[:, :, 0]                 # [Q, Q]
+    q_reclaim_inh = _inherit(q_reclaim_mrt)
+    q_reclaim_eff = q_reclaim_inh[np.maximum(start_q, 0)]
+    q_reclaim_eff[start_q < 0] = 0.0
+
     # --- pod groups + tasks ----------------------------------------------
     group_names = [g.name for g in pod_groups]
     g_index = {name: i for i, name in enumerate(group_names)}
@@ -471,6 +528,10 @@ def build_snapshot(
         node_filters.EMPTY_SPEC: apis.Pod("", "")}
 
     def filter_class_of(pod: apis.Pod) -> int:
+        # fast path: the overwhelming majority of pods carry no filter
+        # spec at all — class 0 without building the canonical key
+        if not (pod.tolerations or pod.node_affinity or pod.pod_affinity):
+            return 0
         key = node_filters.pod_filter_spec(pod)
         if key not in spec_index:
             spec_index[key] = len(filter_specs)
@@ -481,10 +542,14 @@ def build_snapshot(
     node_idx0 = {name: i for i, name in enumerate(node_names)}
     task_type_index: dict[tuple, int] = {}
     task_names: list[list[str | None]] = [[None] * T for _ in range(G)]
+    flat_tasks: list[tuple[int, int, apis.Pod]] = []
     for i, g in enumerate(pod_groups):
         tasks = pending_by_group[g.name]
-        # task-order plugin semantics: priority desc, then creation asc
-        tasks.sort(key=lambda p: (-p.priority, p.creation_timestamp, p.name))
+        # task-order semantics: kubeflow/ray leader pods first (ref
+        # plugins/kubeflow + plugins/ray TaskOrderFn on the job-role /
+        # node-type labels), then priority desc, then creation asc
+        # (taskorder plugin)
+        tasks.sort(key=_task_order_key)
         gk["queue"][i] = q_index.get(g.queue, 0)
         gk["min_member"][i] = g.min_member
         gk["priority"][i] = g.priority
@@ -514,36 +579,71 @@ def build_snapshot(
                 gk["required_level"][i] = topo_levels.index(tc.required_level)
             if tc.preferred_level in topo_levels:
                 gk["preferred_level"][i] = topo_levels.index(tc.preferred_level)
+        # a gang-level required topology level is enforced through the
+        # subgroup machinery: subgroups without their own constraint
+        # (incl. the default slot 0) inherit it, so every task locks into
+        # ONE domain at that level with the capacity-aware first pick
+        if gk["required_level"][i] >= 0:
+            for si in range(S):
+                if gk["subgroup_required_level"][i, si] < 0:
+                    gk["subgroup_required_level"][i, si] = \
+                        gk["required_level"][i]
         for t, pod in enumerate(tasks[:T]):
-            gk["task_req"][i, t] = pod.resources.as_tuple()
-            # fractional / memory-based requests carry their share in the
-            # accel slot so queue & node totals stay consistent
-            # (memory-based quantified against the cluster-min device
-            # memory, ref GetTasksToAllocateInitResource MinNodeGPUMemory)
-            if pod.accel_portion > 0:
-                gk["task_req"][i, t, 0] = pod.accel_portion
-            elif pod.accel_memory_gib > 0:
-                gk["task_req"][i, t, 0] = pod.accel_memory_gib / min_dev_mem
-            gk["task_valid"][i, t] = True
-            gk["task_portion"][i, t] = pod.accel_portion
-            gk["task_accel_mem"][i, t] = pod.accel_memory_gib
-            gk["task_filter_class"][i, t] = filter_class_of(pod)
-            gk["task_subgroup"][i, t] = sub_slot[i].get(pod.subgroup or "", 0)
+            flat_tasks.append((i, t, pod))
+            task_names[i][t] = pod.name
+
+    # --- bulk task-field assignment (one vectorized write per field
+    # instead of per-pod numpy scalar writes — the host snapshot must
+    # stay a small fraction of the device cycle at 50k pods) ------------
+    if flat_tasks:
+        nf = len(flat_tasks)
+        gi_a = np.fromiter((f[0] for f in flat_tasks), np.int64, nf)
+        ti_a = np.fromiter((f[1] for f in flat_tasks), np.int64, nf)
+        fpods = [f[2] for f in flat_tasks]
+        req_a = np.array([p.resources.as_tuple() for p in fpods],
+                         np.float32)
+        por_a = np.fromiter((p.accel_portion for p in fpods), np.float32,
+                            nf)
+        mem_a = np.fromiter((p.accel_memory_gib for p in fpods),
+                            np.float32, nf)
+        # fractional / memory-based requests carry their share in the
+        # accel slot so queue & node totals stay consistent (memory-based
+        # quantified against the cluster-min device memory, ref
+        # GetTasksToAllocateInitResource MinNodeGPUMemory)
+        req_a[:, 0] = np.where(
+            por_a > 0, por_a,
+            np.where(mem_a > 0, mem_a / min_dev_mem, req_a[:, 0]))
+        cls_a = np.fromiter((filter_class_of(p) for p in fpods), np.int32,
+                            nf)
+        gk["task_req"][gi_a, ti_a] = req_a
+        gk["task_valid"][gi_a, ti_a] = True
+        gk["task_portion"][gi_a, ti_a] = por_a
+        gk["task_accel_mem"][gi_a, ti_a] = mem_a
+        gk["task_filter_class"][gi_a, ti_a] = cls_a
+        default_sel_bytes = np.full((K,), -1, np.int32).tobytes()
+        for j, (i, t, pod) in enumerate(flat_tasks):
+            if sub_slot[i]:
+                gk["task_subgroup"][i, t] = sub_slot[i].get(
+                    pod.subgroup or "", 0)
             if pod.nominated_node is not None:
                 gk["task_nominated"][i, t] = node_idx0.get(
                     pod.nominated_node, -1)
-            asl = node_filters.anti_self_level(pod, topo_levels, L)
-            if asl >= 0:
-                cur = gk["anti_self_level"][i]
-                gk["anti_self_level"][i] = asl if cur < 0 else min(cur, asl)
-            task_names[i][t] = pod.name
-            for ki, key in enumerate(selector_keys):
-                if key in pod.node_selector:
-                    gk["task_selector"][i, t, ki] = value_id(key, pod.node_selector[key])
-            tkey = (gk["task_req"][i, t].tobytes(),
-                    gk["task_selector"][i, t].tobytes(),
-                    float(pod.accel_portion), float(pod.accel_memory_gib),
-                    int(gk["task_filter_class"][i, t]))
+            if pod.pod_affinity:
+                asl = node_filters.anti_self_level(pod, topo_levels, L)
+                if asl >= 0:
+                    cur = gk["anti_self_level"][i]
+                    gk["anti_self_level"][i] = (asl if cur < 0
+                                                else min(cur, asl))
+            if pod.node_selector:
+                for ki, key in enumerate(selector_keys):
+                    if key in pod.node_selector:
+                        gk["task_selector"][i, t, ki] = value_id(
+                            key, pod.node_selector[key])
+                sel_bytes = gk["task_selector"][i, t].tobytes()
+            else:
+                sel_bytes = default_sel_bytes
+            tkey = (req_a[j].tobytes(), sel_bytes,
+                    float(por_a[j]), float(mem_a[j]), int(cls_a[j]))
             gk["task_type"][i, t] = task_type_index.setdefault(
                 tkey, len(task_type_index))
 
@@ -572,25 +672,83 @@ def build_snapshot(
     running_names: list[str] = [""] * M
     if now is None:
         now = max([p.creation_timestamp for p in pods], default=0.0)
+    Mu = len(running_pods)
+    if Mu:
+        # --- bulk per-pod fields (vectorized; the device-occupancy and
+        # memory-share paths below stay per-pod but are guarded) ----------
+        r_req = np.array([p.resources.as_tuple() for p in running_pods],
+                         np.float32)
+        r_node = np.fromiter(
+            (node_idx.get(p.node, -1) for p in running_pods), np.int32, Mu)
+        r_por = np.fromiter((p.accel_portion for p in running_pods),
+                            np.float32, Mu)
+        r_mem = np.fromiter((p.accel_memory_gib for p in running_pods),
+                            np.float32, Mu)
+        r_grp = np.fromiter(
+            (g_index.get(p.group, -1) for p in running_pods), np.int32, Mu)
+        r_rel = np.fromiter(
+            (p.status == apis.PodStatus.RELEASING for p in running_pods),
+            bool, Mu)
+        # a running pod's node is known: debit its *actual* per-node
+        # share so free accel stays equal to device_free.sum(-1)
+        # (pending pods use the canonical cluster-min quantification)
+        dm = np.where(r_node >= 0,
+                      node_dev_mem[np.maximum(r_node, 0)], min_dev_mem)
+        r_req[:, 0] = np.where(
+            r_por > 0, r_por,
+            np.where(r_mem > 0, r_mem / np.maximum(dm, 1e-6), r_req[:, 0]))
+        rk["req"][:Mu] = r_req
+        rk["node"][:Mu] = r_node
+        rk["accel_mem"][:Mu] = r_mem
+        rk["gang"][:Mu] = r_grp
+        rk["valid"][:Mu] = True
+        rk["releasing"][:Mu] = r_rel
+        rk["filter_class"][:Mu] = np.fromiter(
+            (filter_class_of(p) for p in running_pods), np.int32, Mu)
+        # group-derived fields via per-group tables + one gather
+        ng = len(pod_groups)
+        pg_queue = np.fromiter(
+            (q_index.get(g2.queue, 0) for g2 in pod_groups), np.int32,
+            ng) if ng else np.zeros((0,), np.int32)
+        pg_prio = np.fromiter((g2.priority for g2 in pod_groups), np.int32,
+                              ng) if ng else np.zeros((0,), np.int32)
+        pg_pre = np.fromiter(
+            (g2.preemptibility == apis.Preemptibility.PREEMPTIBLE
+             for g2 in pod_groups), bool, ng) if ng else np.zeros((0,), bool)
+        # float64: unix-epoch timestamps lose ~128s of precision in
+        # float32, which corrupts minruntime protection windows
+        pg_start = np.array(
+            [(-1.0 if g2.last_start_timestamp is None
+              else g2.last_start_timestamp) for g2 in pod_groups],
+            np.float64) if ng else np.zeros((0,), np.float64)
+        has_grp = r_grp >= 0
+        gsafe = np.maximum(r_grp, 0)
+        if ng:
+            rk["queue"][:Mu] = np.where(has_grp, pg_queue[gsafe], 0)
+            rk["priority"][:Mu] = np.where(has_grp, pg_prio[gsafe], 0)
+            rk["preemptible"][:Mu] = has_grp & pg_pre[gsafe]
+            started = pg_start[gsafe]
+            rk["runtime_s"][:Mu] = np.where(
+                has_grp & (started >= 0),
+                np.maximum(0.0, now - started), 0.0)
+        np.add.at(gk["running_count"], gsafe[has_grp & ~r_rel], 1)
+        # subgroup attribution: pods of plain gangs (no declared
+        # subgroups) count toward the default slot 0 in bulk; only gangs
+        # with declared subgroups need the per-pod name lookup
+        has_subs = np.fromiter((bool(s) for s in sub_slot), bool, G)
+        active = has_grp & ~r_rel
+        plain = active & ~has_subs[gsafe]
+        np.add.at(sub_running, (gsafe[plain], np.zeros(int(plain.sum()),
+                                                      np.int64)), 1)
+        for j in np.nonzero(active & has_subs[gsafe])[0]:
+            sub_running[r_grp[j], sub_slot[r_grp[j]].get(
+                running_pods[j].subgroup or "", 0)] += 1
     for j, pod in enumerate(running_pods):
-        grp = g_index.get(pod.group, -1)
-        rk["req"][j] = pod.resources.as_tuple()
-        rk["node"][j] = node_idx.get(pod.node, -1)
-        rk["accel_mem"][j] = pod.accel_memory_gib
-        rk["filter_class"][j] = filter_class_of(pod)
-        if pod.accel_portion > 0:
-            rk["req"][j, 0] = pod.accel_portion
-        elif pod.accel_memory_gib > 0:
-            # a running pod's node is known: debit its *actual* per-node
-            # share so free accel stays equal to device_free.sum(-1)
-            # (pending pods use the canonical cluster-min quantification)
-            ni0 = int(rk["node"][j])
-            dm = node_dev_mem[ni0] if ni0 >= 0 else min_dev_mem
-            rk["req"][j, 0] = pod.accel_memory_gib / max(dm, 1e-6)
-        rk["gang"][j] = grp
+        running_names[j] = pod.name
         # --- device occupancy (GPU-group bookkeeping) --------------------
         ni = int(rk["node"][j])
-        if ni >= 0:
+        if ni >= 0 and (pod.resources.accel > 0 or pod.accel_portion > 0
+                        or pod.accel_memory_gib > 0):
             is_frac = pod.accel_portion > 0 or pod.accel_memory_gib > 0
             if is_frac:
                 p = (pod.accel_portion if pod.accel_portion > 0
@@ -623,19 +781,6 @@ def build_snapshot(
                         mask |= 1 << int(d0)
                     rk["devices_mask"][j] = mask
                     rk["accel_held"][j] = float(len(devs))
-        if grp >= 0:
-            pg = pod_groups[grp]
-            rk["queue"][j] = q_index.get(pg.queue, 0)
-            rk["priority"][j] = pg.priority
-            rk["preemptible"][j] = pg.preemptibility == apis.Preemptibility.PREEMPTIBLE
-            started = pg.last_start_timestamp
-            rk["runtime_s"][j] = max(0.0, now - started) if started is not None else 0.0
-        rk["valid"][j] = True
-        rk["releasing"][j] = pod.status == apis.PodStatus.RELEASING
-        running_names[j] = pod.name
-        if grp >= 0 and pod.status != apis.PodStatus.RELEASING:
-            gk["running_count"][grp] += 1
-            sub_running[grp, sub_slot[grp].get(pod.subgroup or "", 0)] += 1
     for i, grp_obj in enumerate(pod_groups):
         if grp_obj.stale_since is not None:
             gk["stale_s"][i] = max(0.0, now - grp_obj.stale_since)
@@ -672,17 +817,15 @@ def build_snapshot(
                 int(gk["anti_self_level"][i]), bool(gk["preemptible"][i]))
         gk["sig"][i] = sig_index.setdefault(skey, len(sig_index))
 
-    # --- derived node free / releasing -----------------------------------
+    # --- derived node free / releasing (vectorized scatter-adds) ---------
     node_used = np.zeros((N, R), np.float32)
     node_rel = np.zeros((N, R), np.float32)
-    for j, pod in enumerate(running_pods):
-        ni = rk["node"][j]
-        if ni < 0:
-            continue  # unknown node: counts for queues, not for node capacity
-        if pod.status == apis.PodStatus.RELEASING:
-            node_rel[ni] += rk["req"][j]
-        else:
-            node_used[ni] += rk["req"][j]
+    on_node = rk["valid"] & (rk["node"] >= 0)
+    rel_m = on_node & rk["releasing"]
+    used_m = on_node & ~rk["releasing"]
+    # unknown nodes count for queues, not for node capacity
+    np.add.at(node_rel, rk["node"][rel_m], rk["req"][rel_m])
+    np.add.at(node_used, rk["node"][used_m], rk["req"][used_m])
     node_free = np.maximum(node_alloc - node_used - node_rel, 0.0)
 
     # --- derived queue allocated / request (host mirror of
@@ -690,17 +833,15 @@ def build_snapshot(
     q_alloc = np.zeros((Q, R), np.float32)
     q_alloc_np = np.zeros((Q, R), np.float32)
     q_request = np.zeros((Q, R), np.float32)
-    for j in range(len(running_pods)):
-        if rk["valid"][j]:
-            qi = rk["queue"][j]
-            q_alloc[qi] += rk["req"][j]
-            q_request[qi] += rk["req"][j]
-            if not rk["preemptible"][j]:
-                q_alloc_np[qi] += rk["req"][j]
-    for i in range(len(pod_groups)):
-        if gk["valid"][i]:
-            qi = gk["queue"][i]
-            q_request[qi] += gk["task_req"][i][gk["task_valid"][i]].sum(axis=0)
+    vmask = rk["valid"]
+    np.add.at(q_alloc, rk["queue"][vmask], rk["req"][vmask])
+    np_mask = vmask & ~rk["preemptible"]
+    np.add.at(q_alloc_np, rk["queue"][np_mask], rk["req"][np_mask])
+    q_request += q_alloc
+    pending_req = (gk["task_req"]
+                   * gk["task_valid"][:, :, None]).sum(axis=1)  # [G, R]
+    np.add.at(q_request, gk["queue"][gk["valid"]],
+              pending_req[gk["valid"]])
     # historical usage (usagedb feed), normalized usage/clusterCapacity —
     # the k_value term of the DRF waterfill (ref usagedb.go:20-60)
     q_usage = np.zeros((Q, R), np.float32)
@@ -732,6 +873,9 @@ def build_snapshot(
     tvm = gk["task_valid"][:, :, None]
     uniform = (
         not has_fracs
+        # declared subgroups need the per-task path; a gang-level
+        # required topology level (slot 0) is native to the whole-gang
+        # kernel's single-domain fill
         and not any(g.sub_groups for g in pod_groups)
         and bool((gk["task_nominated"] < 0).all())
         # per-node anti-self is supported by the whole-gang kernel (one
@@ -779,6 +923,8 @@ def build_snapshot(
             creation_order=jnp.asarray(q_creation),
             preempt_min_runtime=jnp.asarray(q_preempt_mrt, dtype),
             reclaim_min_runtime=jnp.asarray(q_reclaim_mrt, dtype),
+            preempt_min_runtime_eff=jnp.asarray(q_preempt_eff, dtype),
+            reclaim_min_runtime_eff=jnp.asarray(q_reclaim_eff, dtype),
         ),
         gangs=GangState(**{k: jnp.asarray(v) for k, v in gk.items()}),
         running=RunningState(**{k: jnp.asarray(v) for k, v in rk.items()}),

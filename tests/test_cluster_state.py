@@ -64,3 +64,35 @@ def test_topology_domains_nest():
     for d in np.unique(t[:, 1]):
         rows = t[t[:, 1] == d]
         assert len(np.unique(rows[:, 0])) == 1
+
+
+def test_plain_gang_running_pods_fill_default_subgroup_quorum():
+    """Running pods of a gang with no declared subgroups must count
+    toward the default subgroup slot 0 (regression: a fast-path guard
+    skipped them, inflating subgroup_min_needed to the full minMember)."""
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=8))]
+    groups = [apis.PodGroup("g", queue="q", min_member=4,
+                            last_start_timestamp=0.0)]
+    pods = [apis.Pod(f"r{i}", "g", apis.ResourceVec(1, 1, 1),
+                     status=apis.PodStatus.RUNNING, node="n0")
+            for i in range(3)]
+    pods += [apis.Pod(f"p{i}", "g", apis.ResourceVec(1, 1, 1))
+             for i in range(3)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    assert int(np.asarray(state.gangs.subgroup_min_needed)[0, 0]) == 1
+    assert int(np.asarray(state.gangs.min_needed)[0]) == 1
+
+
+def test_runtime_seconds_precision_at_unix_epoch_scale():
+    """runtime_s must not quantize to float32 at unix-timestamp scale
+    (regression: 90s became 128s, corrupting minruntime windows)."""
+    start = 1753800000.0
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=8))]
+    groups = [apis.PodGroup("g", queue="q", min_member=1,
+                            last_start_timestamp=start)]
+    pods = [apis.Pod("r0", "g", apis.ResourceVec(1, 1, 1),
+                     status=apis.PodStatus.RUNNING, node="n0")]
+    state, _ = build_snapshot(nodes, queues, groups, pods, now=start + 90.0)
+    assert abs(float(np.asarray(state.running.runtime_s)[0]) - 90.0) < 1.0

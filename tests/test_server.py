@@ -1,0 +1,49 @@
+"""Sidecar/PluginServer tests — ref ``plugins/reflectjoborder``,
+``plugins/snapshot`` HTTP endpoints and the snapshot-in/placements-out
+wire boundary (SURVEY.md §7d)."""
+import json
+import urllib.request
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.server import SchedulerServer, run_cycle_doc
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.runtime.snapshot import dump_cluster
+from kai_scheduler_tpu.state import make_cluster
+
+
+def _cluster():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, node_accel=8.0, num_gangs=4, tasks_per_gang=2)
+    return Cluster.from_objects(nodes, queues, groups, pods, topo)
+
+
+def test_run_cycle_doc_round_trip():
+    doc = dump_cluster(_cluster())
+    out = run_cycle_doc(doc)
+    assert len(out["bind_requests"]) == 8
+    assert out["evictions"] == []
+    # deterministic across calls on the same document
+    assert run_cycle_doc(doc)["bind_requests"] == out["bind_requests"]
+
+
+def test_http_endpoints():
+    server = SchedulerServer(_cluster()).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        order = json.load(urllib.request.urlopen(f"{base}/job-order"))
+        assert len(order) == 4 and {"pod_group", "queue"} <= set(order[0])
+
+        snap = json.load(urllib.request.urlopen(f"{base}/snapshot"))
+        assert len(snap["nodes"]) == 4
+
+        req = urllib.request.Request(
+            f"{base}/cycle", data=json.dumps(snap).encode(),
+            headers={"Content-Type": "application/json"})
+        cycle = json.load(urllib.request.urlopen(req))
+        assert len(cycle["bind_requests"]) == 8
+
+        metrics_text = urllib.request.urlopen(
+            f"{base}/metrics").read().decode()
+        assert "kai_e2e_scheduling_latency_seconds" in metrics_text
+    finally:
+        server.stop()

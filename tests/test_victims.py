@@ -107,17 +107,70 @@ class TestReclaim:
         assert not bool(res.allocated[index.gang_names.index("pg")])
         assert int(np.asarray(res.victim).sum()) == 0
 
-    def test_minruntime_protects_victims(self):
-        # victims have run 10s < reclaimMinRuntime 60s -> protected.
+    def test_minruntime_protects_quorum_not_surplus(self):
+        # victims have run 10s < reclaimMinRuntime 60s -> protected.  The
+        # running gang is ELASTIC (minMember 1, 8 pods): protection keeps
+        # its quorum but surplus pods remain reclaimable (ref
+        # minruntime reclaimFilterFn passing elastic jobs through to the
+        # below-minAvailable scenario validator).
         state, index = two_queue_cluster(reclaim_mrt=60.0,
                                          victim_runtime=10.0)
         res, _ = run_reclaim(state)
-        assert int(np.asarray(res.victim).sum()) == 0
+        n_vic = int(np.asarray(res.victim).sum())
+        assert 0 < n_vic <= 7  # at least minMember=1 pod survives
         # once they've run long enough, reclaim proceeds
         state2, index2 = two_queue_cluster(reclaim_mrt=60.0,
                                            victim_runtime=120.0)
         res2, _ = run_reclaim(state2)
         assert bool(res2.allocated[index2.gang_names.index("pending-gang")])
+
+    def test_minruntime_fully_protects_nonelastic_gang(self):
+        # minMember == pod count: no surplus, the whole gang is its
+        # quorum — a protected gang yields zero victims.
+        nodes = [apis.Node("node-0", Vec(8.0, 64.0, 256.0))]
+        queues = [apis.Queue("q0", accel=QR(quota=4.0)),
+                  apis.Queue("q1", accel=QR(quota=4.0),
+                             reclaim_min_runtime=60.0)]
+        running = apis.PodGroup("rg", queue="q1", min_member=8,
+                                creation_timestamp=0.0,
+                                last_start_timestamp=0.0)
+        pending = apis.PodGroup("pg", queue="q0", min_member=2,
+                                creation_timestamp=1.0)
+        pods = [apis.Pod(f"v{i}", "rg", resources=Vec(1.0, 1.0, 4.0),
+                         status=apis.PodStatus.RUNNING, node="node-0")
+                for i in range(8)]
+        pods += [apis.Pod(f"p{i}", "pg", resources=Vec(2.0, 1.0, 4.0),
+                          creation_timestamp=1.0) for i in range(2)]
+        state, _ = build_snapshot(nodes, queues, [running, pending], pods,
+                                  now=10.0)
+        res, _ = run_reclaim(state)
+        assert int(np.asarray(res.victim).sum()) == 0
+
+    def test_minruntime_inherited_from_parent_queue(self):
+        """A leaf without reclaimMinRuntime inherits its department's —
+        ref plugins/minruntime/resolver.go inheritance walk."""
+        nodes = [apis.Node("node-0", Vec(8.0, 64.0, 256.0))]
+        queues = [
+            apis.Queue("dept-a", accel=QR(quota=4.0)),
+            apis.Queue("dept-b", accel=QR(quota=4.0),
+                       reclaim_min_runtime=60.0),
+            apis.Queue("qa", parent="dept-a", accel=QR(quota=4.0)),
+            apis.Queue("qb", parent="dept-b", accel=QR(quota=4.0)),
+        ]
+        running = apis.PodGroup("rg", queue="qb", min_member=8,
+                                creation_timestamp=0.0,
+                                last_start_timestamp=0.0)
+        pending = apis.PodGroup("pg", queue="qa", min_member=2,
+                                creation_timestamp=1.0)
+        pods = [apis.Pod(f"v{i}", "rg", resources=Vec(1.0, 1.0, 4.0),
+                         status=apis.PodStatus.RUNNING, node="node-0")
+                for i in range(8)]
+        pods += [apis.Pod(f"p{i}", "pg", resources=Vec(2.0, 1.0, 4.0),
+                          creation_timestamp=1.0) for i in range(2)]
+        state, _ = build_snapshot(nodes, queues, [running, pending], pods,
+                                  now=10.0)
+        res, _ = run_reclaim(state, num_levels=2)
+        assert int(np.asarray(res.victim).sum()) == 0  # qb inherits 60s
 
 
 def preempt_cluster(*, preemptor_priority=100, victim_priority=50,
