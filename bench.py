@@ -142,7 +142,8 @@ def bench_reclaim(iters: int) -> dict:
     from kai_scheduler_tpu.ops.victims import run_victim_action
     ses = _session(
         num_nodes=10_000, node_accel=8.0, num_gangs=6250, tasks_per_gang=8,
-        running_fraction=0.5, queue_accel_quota=5000.0)
+        running_fraction=0.5, queue_accel_quota=1000.0,
+        partition_queues_by_running=True)
     num_levels = ses.config.num_levels
     config = ses.config.victims
 

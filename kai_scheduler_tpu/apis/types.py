@@ -153,6 +153,12 @@ class Pod:
     #: preempted pods carry the node their preemption cleared — the
     #: nominatednode plugin gives it a dominating score bonus
     nominated_node: str | None = None
+    #: extended scalar requests — MIG profiles etc. (ref migResources)
+    extended: dict[str, float] = dataclasses.field(default_factory=dict)
+    #: accelerators requested through DRA ResourceClaims — added to the
+    #: accel accounting like whole devices (ref draGpuCounts; the claim
+    #: allocation is recorded on the BindRequest)
+    dra_accel_count: int = 0
     creation_timestamp: float = 0.0
 
 
@@ -344,6 +350,10 @@ class Node:
     taints: list["Taint"] = dataclasses.field(default_factory=list)
     #: accelerator memory per device, GiB (for memory-based sharing)
     accel_memory_gib: float = 16.0
+    #: extended scalar resources — MIG profiles
+    #: (e.g. {"nvidia.com/mig-1g.5gb": 4}) and any other named scalar
+    #: (ref GpuResourceRequirement.migResources / Resource.scalars)
+    extended: dict[str, float] = dataclasses.field(default_factory=dict)
     unschedulable: bool = False
 
 
@@ -383,6 +393,10 @@ class BindRequest:
     #: device indices chosen by the scheduler (fractional: the shared
     #: device; whole: filled by the binder) — ref SelectedGPUGroups
     selected_accel_groups: list[int] = dataclasses.field(default_factory=list)
+    #: devices satisfied through DRA ResourceClaims — ref
+    #: ResourceClaimAllocations; count equals the pod's dra_accel_count
+    resource_claim_allocations: list[int] = dataclasses.field(
+        default_factory=list)
     backoff_limit: int = 3
     #: filled by the binder
     phase: str = "Pending"   # Pending | Succeeded | Failed

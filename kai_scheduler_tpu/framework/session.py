@@ -75,16 +75,19 @@ class Session:
             uniform = index.uniform_gangs and not devices
             sub_topo = (index.has_subgroup_topology
                         or index.has_required_topology)
+            ext = index.has_extended_resources
             config = dataclasses.replace(
                 config,
                 allocate=dataclasses.replace(
                     config.allocate, track_devices=devices,
-                    uniform_tasks=uniform, subgroup_topology=sub_topo),
+                    uniform_tasks=uniform, subgroup_topology=sub_topo,
+                    extended=ext),
                 victims=dataclasses.replace(
                     config.victims,
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
-                        uniform_tasks=uniform, subgroup_topology=sub_topo)))
+                        uniform_tasks=uniform, subgroup_topology=sub_topo,
+                        extended=ext)))
         fair_share = drf.set_fair_share(
             state, num_levels=config.num_levels, k_value=config.k_value)
         state = state.replace(queues=state.queues.replace(fair_share=fair_share))
@@ -109,6 +112,7 @@ class Session:
         portions = np.asarray(self.state.gangs.task_portion)
         mems = np.asarray(self.state.gangs.task_accel_mem)
         reqs = np.asarray(self.state.gangs.task_req)
+        dras = np.asarray(self.state.gangs.task_dra)
         # one vectorized selection, then O(placements) object building —
         # never an O(G x T) Python scan
         sel = allocated[:, None] & (placements >= 0) & ~pipelined
@@ -134,6 +138,10 @@ class Session:
                 received_accel_count=(
                     0 if is_frac else int(round(float(reqs[gi, ti, 0])))),
                 selected_accel_groups=[dev] if dev >= 0 else [],
+                # DRA claim allocations: the binder resolves concrete
+                # devices; the record carries the claimed count (ref
+                # ResourceClaimAllocations)
+                resource_claim_allocations=list(range(int(dras[gi, ti]))),
                 backoff_limit=self.config.default_bind_backoff_limit,
             ))
         return out

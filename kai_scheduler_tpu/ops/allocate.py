@@ -63,6 +63,8 @@ class AllocationResult(struct.PyTreeNode):
     """
 
     placements: jax.Array     # i32 [G, T]  node index per task, -1 unplaced
+    #: extended scalar-resource pool after commits — f32 [N, E]
+    extended_free: jax.Array
     #: shared-device index per fractional task (-1 = whole-device/none) —
     #: feeds BindRequest.selected_accel_groups
     placement_device: jax.Array  # i32 [G, T]
@@ -103,6 +105,7 @@ def init_result(state: ClusterState) -> AllocationResult:
     G, T = g.g, g.t
     return AllocationResult(
         placements=jnp.full((G, T), -1, jnp.int32),
+        extended_free=n.extended_free,
         placement_device=jnp.full((G, T), -1, jnp.int32),
         pipelined=jnp.zeros((G, T), bool),
         allocated=jnp.zeros((G,), bool),
@@ -217,6 +220,12 @@ class AllocateConfig:
     #: reduction per task step; False when the snapshot holds no required
     #: topology constraint.  Session derives this automatically.
     subgroup_topology: bool = True
+    #: compile extended scalar-resource (MIG/DRA) fit + accounting.
+    #: False when the snapshot carries none.  Session derives this
+    #: automatically.  Extended enforcement covers the allocate path;
+    #: victim scenarios do not credit evicted pods' extended resources
+    #: (conservative for preemptors that need them).
+    extended: bool = False
     #: skip gangs whose scheduling signature already failed this action —
     #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
     signature_skip: bool = True
@@ -235,7 +244,8 @@ def _attempt_gang_in_domain(
         lane: jax.Array,               # i32 [] wavefront lane (tie-break)
         chain: jax.Array,              # bool [Q, Q] ancestor membership
         prior_nodes: jax.Array | None = None,  # i32 [T] prior placements
-        quota: jax.Array | None = None     # i32 [] max new placements
+        quota: jax.Array | None = None,    # i32 [] max new placements
+        ext_free: jax.Array | None = None  # f32 [N, E] extended pool
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -271,6 +281,9 @@ def _attempt_gang_in_domain(
     task_mem = g.task_accel_mem[gang_idx]    # [T]
     task_class = g.task_filter_class[gang_idx]  # [T]
     task_nom = g.task_nominated[gang_idx]    # [T]
+    task_ext = g.task_extended[gang_idx]     # [T, E]
+    if ext_free is None:
+        ext_free = n.extended_free
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
     # gang-internal anti-affinity: no two tasks in the same domain at
@@ -383,8 +396,9 @@ def _attempt_gang_in_domain(
     gate_t = gate_lim & jnp.where(nonpreempt, gate_quota, True)  # [T]
 
     def task_body(t, carry):
-        (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom, sub_rem,
-         agg, nodes_t, dev_t, pipe_t, count, q_delta, pref_dom) = carry
+        (free_l, dev_l, ext_l, bind_used, dev_bind, ext_bind, forbidden,
+         sub_dom, sub_rem, agg, nodes_t, dev_t, pipe_t, count, q_delta,
+         pref_dom) = carry
         req = task_req[t]
         is_frac = (task_portion[t] > 0) | (task_mem[t] > 0)
         ok = eligible_t[t] & gate_t[t]
@@ -396,6 +410,13 @@ def _attempt_gang_in_domain(
             extra_device_releasing=extra_device_releasing,
             devices=config.track_devices,
             task_class=task_class[t])
+        if config.extended:
+            te = task_ext[t]                                           # [E]
+            fit_idle = fit_idle & jnp.all(
+                ext_l + EPS >= te[None, :], axis=-1)
+            fit_pipe = fit_pipe & jnp.all(
+                ext_l + n.extended_releasing + EPS >= te[None, :],
+                axis=-1)
         allowed = domain_mask & ~forbidden
         # per-subgroup required level: once the subgroup's first task
         # lands, its whole domain at that level is locked for the rest.
@@ -511,6 +532,11 @@ def _attempt_gang_in_domain(
         # chunk-start idle pool (pipelined tasks legitimately overdraw it)
         bind_used = bind_used.at[node].add(
             jnp.where(is_pipe, 0.0, delta_node))
+        if config.extended:
+            ext_delta = jnp.where(placed, task_ext[t], 0.0)
+            ext_l = ext_l.at[node].add(-ext_delta)
+            ext_bind = ext_bind.at[node].add(
+                jnp.where(is_pipe, 0.0, ext_delta))
         q_delta = q_delta + delta
         # anti-self: the chosen node's whole domain is off-limits for the
         # gang's remaining tasks
@@ -534,9 +560,9 @@ def _attempt_gang_in_domain(
         count = count + placed.astype(jnp.int32)
         pref_dom = jnp.where(placed & (pref_dom < 0), pref_doms[node],
                              pref_dom)
-        return (free_l, dev_l, bind_used, dev_bind, forbidden, sub_dom,
-                sub_rem, agg, nodes_t, dev_t, pipe_t, count, q_delta,
-                pref_dom)
+        return (free_l, dev_l, ext_l, bind_used, dev_bind, ext_bind,
+                forbidden, sub_dom, sub_rem, agg, nodes_t, dev_t, pipe_t,
+                count, q_delta, pref_dom)
 
     # seed subgroup domain locks from prior placements
     prior_level = srl[sub]                                              # [T]
@@ -545,8 +571,9 @@ def _attempt_gang_in_domain(
     sub_dom0 = jnp.full((S,), -1, jnp.int32).at[sub].max(
         jnp.where(already & (prior_level >= 0), prior_sub_dom, -1))
 
-    carry = (free, device_free,
+    carry = (free, device_free, ext_free,
              jnp.zeros_like(free), jnp.zeros_like(device_free),
+             jnp.zeros_like(ext_free),
              forbidden0, sub_dom0, sub_rem0, agg0,
              jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
              jnp.zeros((T,), bool),
@@ -557,8 +584,8 @@ def _attempt_gang_in_domain(
     # victim solver) — unrolling T copies made compile time the suite's
     # bottleneck while saving only ~µs of loop overhead per step
     carry = lax.fori_loop(0, T, task_body, carry)
-    (free2, dev2, bind_used, dev_bind, _, _, _, _, nodes_t, dev_t, pipe_t,
-     count, q_delta, _) = carry
+    (free2, dev2, ext2, bind_used, dev_bind, ext_bind, _, _, _, _,
+     nodes_t, dev_t, pipe_t, count, q_delta, _) = carry
     # queue accounting applied once for the whole gang along its chain
     qa2 = q_alloc + anc[:, None] * q_delta[None, :]
     qan2 = q_alloc_np + jnp.where(nonpreempt,
@@ -572,7 +599,7 @@ def _attempt_gang_in_domain(
         # re-push protocol: the attempt's chunk is all-or-nothing
         success = (goal > 0) & (count >= goal)
     return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
-            bind_used, dev_bind)
+            bind_used, dev_bind, ext2, ext_bind)
 
 
 def _attempt_gang_in_domain_uniform(
@@ -584,7 +611,8 @@ def _attempt_gang_in_domain_uniform(
         extra_releasing: jax.Array, extra_device_releasing: jax.Array,
         lane: jax.Array, chain: jax.Array,
         prior_nodes: jax.Array | None = None,
-        quota: jax.Array | None = None):
+        quota: jax.Array | None = None,
+        ext_free: jax.Array | None = None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
     A gang whose T pending tasks are identical replicas (the dominant
@@ -701,7 +729,10 @@ def _attempt_gang_in_domain_uniform(
             jnp.any(already),
             dom_col[jnp.maximum(prior_nodes[jnp.argmax(already)], 0)], -1)
         target = jnp.where(prior_dom >= 0, prior_dom, target)
-        in_dom = ~has_req | (dom_col == target)
+        # target == -1 (no domain fits) must FAIL the gang, not fall
+        # through to nodes that lack the level's label (their dom_col is
+        # also -1)
+        in_dom = ~has_req | ((target >= 0) & (dom_col == target))
         fit_idle = fit_idle & in_dom
         fit_pipe = fit_pipe & in_dom
         c_pipe = jnp.where(in_dom, c_pipe, 0)
@@ -763,8 +794,13 @@ def _attempt_gang_in_domain_uniform(
     else:
         success = (goal > 0) & (total_placed >= goal)
     dev_t = jnp.full((T,), -1, jnp.int32)
+    # extended resources take the per-task path (snapshot builder gates
+    # uniform_gangs off when any exist) — pass the pool through untouched
+    if ext_free is None:
+        ext_free = state.nodes.extended_free
     return (free2, device_free, qa2, qan2, nodes_t, dev_t, pipe_t, success,
-            bind_used, jnp.zeros_like(device_free))
+            bind_used, jnp.zeros_like(device_free), ext_free,
+            jnp.zeros_like(ext_free))
 
 
 def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
@@ -776,7 +812,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   lane: jax.Array | None = None,
                   chain: jax.Array | None = None,
                   prior_nodes: jax.Array | None = None,
-                  quota: jax.Array | None = None):
+                  quota: jax.Array | None = None,
+                  ext_free: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -814,7 +851,7 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         state, gang_idx, free, device_free, q_alloc, q_alloc_np,
         num_levels, config, n.valid, pref_doms, has_pref,
         extra_releasing, extra_device_releasing, lane, chain,
-        prior_nodes, quota)
+        prior_nodes, quota, ext_free)
 
 
 def allocate(
@@ -880,10 +917,10 @@ def allocate(
 
     chain = _chain_membership(q.parent, num_levels)
 
-    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan):
+    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan, ext):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
                              config, extra, extra_dev, lane, chain,
-                             prior_nodes=prior, quota=quota)
+                             prior_nodes=prior, quota=quota, ext_free=ext)
 
     def cond(carry):
         res, remaining, q_attempts, failed_sig, fuel = carry
@@ -943,11 +980,12 @@ def allocate(
         # a chunk of identical gangs fans out over equal-scoring nodes
         # instead of colliding on one
         lanes = jnp.arange(B, dtype=jnp.int32)
+        ext = res.extended_free
         (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
-         bind_b, devbind_b) = \
+         bind_b, devbind_b, ext2_b, extbind_b) = \
             jax.vmap(attempt_one,
-                     in_axes=(0, 0, 0, 0, None, None, None, None))(
-                cand, lanes, prior_b, quota_b, free, dev, qa, qan)
+                     in_axes=(0, 0, 0, 0, None, None, None, None, None))(
+                cand, lanes, prior_b, quota_b, free, dev, qa, qan, ext)
         succ_b = succ_b & cand_valid
 
         ok = succ_b[:, None, None]
@@ -981,6 +1019,17 @@ def allocate(
         ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff[None] + EPS)
                          | (cum_qan <= EPS), axis=(1, 2))
         accept = ok_node & ok_bind & ok_qa & ok_qan               # [B]
+        if config.extended:
+            d_ext = jnp.where(ok, ext - ext2_b, 0.0)              # [B, N, E]
+            d_extbind = jnp.where(ok, extbind_b, 0.0)
+            cum_ext = jnp.cumsum(d_ext, axis=0)
+            cum_extbind = jnp.cumsum(d_extbind, axis=0)
+            ext_floor = -(n.extended_releasing[None]) - EPS
+            accept = accept & jnp.all(
+                ext[None] - cum_ext >= ext_floor, axis=(1, 2))
+            accept = accept & jnp.all(
+                cum_extbind <= jnp.maximum(ext[None], 0.0) + EPS,
+                axis=(1, 2))
         if config.track_devices:
             d_dev = jnp.where(ok, dev - dev2_b, 0.0)              # [B, N, D]
             d_devbind = jnp.where(ok, devbind_b, 0.0)
@@ -999,6 +1048,8 @@ def allocate(
         qan = qan + jnp.einsum("b,bqr->qr", w, d_qan)
         if config.track_devices:
             dev = dev - jnp.einsum("b,bnd->nd", w, d_dev)
+        if config.extended:
+            ext = ext - jnp.einsum("b,bne->ne", w, d_ext)
 
         nodes_b = jnp.where(take[:, None], nodes_b, -1)
         devt_b = jnp.where(take[:, None], devt_b, -1)
@@ -1025,6 +1076,7 @@ def allocate(
         res = res.replace(
             free=free, device_free=dev, queue_allocated=qa,
             queue_allocated_nonpreemptible=qan,
+            extended_free=ext,
             placements=res.placements.at[cand].set(
                 jnp.where(new_t, nodes_b, res.placements[cand])),
             placement_device=res.placement_device.at[cand].set(

@@ -402,43 +402,48 @@ def solve_for_preemptor(
             # consolidation victims are moved, not removed — their queue
             # allocation stays (allPodsReallocated validator below)
             qa_eff = qa if consolidate else qa - freed_queues
-            # victim search attempts gangs one at a time, so the wavefront
-            # bind-claim tensors (last two outputs) are not needed here
+            # victim search attempts gangs one at a time, so the
+            # wavefront bind-claim tensors are not needed; the preemptor's
+            # extended (MIG/DRA) debit IS kept so later gangs see the
+            # shrunken pool (victims' extended resources are
+            # conservatively NOT credited back)
             (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
-             _, _) = \
+             _, _, ext2, _) = \
                 _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
                               num_levels, alloc_cfg, extra_eff,
-                              extra_dev_eff, chain=chain)
+                              extra_dev_eff, chain=chain,
+                              ext_free=result.extended_free)
             if consolidate:
                 free3, dev3, moves, all_ok = _replace_victims(
                     state, mask_k, free2, dev2, n.releasing + extra_eff,
                     state.nodes.device_releasing + extra_dev_eff)
                 return (free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t,
-                        moves, extra_eff, extra_dev_eff, success & all_ok)
+                        moves, extra_eff, extra_dev_eff, ext2,
+                        success & all_ok)
             return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t,
-                    no_moves, extra_eff, extra_dev_eff, success)
+                    no_moves, extra_eff, extra_dev_eff, ext2, success)
 
         def skip(_):
             return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
                     jnp.full((T,), -1, jnp.int32),
                     jnp.zeros((T,), bool), no_moves, extra, extra_dev,
-                    jnp.asarray(False))
+                    result.extended_free, jnp.asarray(False))
 
         (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, extra2,
-         extra_dev2, success) = \
+         extra_dev2, ext2, success) = \
             lax.cond(prefix_ok & enough[jnp.minimum(k, r.m - 1)],
                      run, skip, None)
         best = jax.tree.map(
             lambda new, old: jnp.where(success, new, old),
             (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-             extra2, extra_dev2, k),
+             extra2, extra_dev2, ext2, k),
             best)
         return k + 1, success, prefix_ok, best
 
     empty = (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
              jnp.full((T,), -1, jnp.int32),
              jnp.zeros((T,), bool), no_moves, extra, extra_dev,
-             jnp.asarray(0, jnp.int32))
+             result.extended_free, jnp.asarray(0, jnp.int32))
 
     def search(_):
         _, done, _, best = lax.while_loop(
@@ -451,12 +456,12 @@ def solve_for_preemptor(
         return jnp.asarray(False), empty
 
     success, (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-              extra2, extra_dev2, k_win) = lax.cond(
+              extra2, extra_dev2, ext2, k_win) = lax.cond(
                   gate & gate_prefilter, search, no_search, None)
 
     victim_mask = cand & (unit_rank <= k_win) & success
     return (success, victim_mask, nodes_t, dev_t, pipe_t, moves,
-            free2, dev2, extra2, extra_dev2, qa2, qan2)
+            free2, dev2, extra2, extra_dev2, qa2, qan2, ext2)
 
 
 def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
@@ -546,14 +551,14 @@ def run_victim_action(
     planned re-placement node in ``victim_move``.
     """
     assert mode in ("reclaim", "preempt", "consolidate"), mode
-    g, q = state.gangs, state.queues
+    g, q, r = state.gangs, state.queues, state.running
     G = g.g
     total = state.total_capacity
     chain = _chain_membership(q.parent, num_levels)
     steps = G if config.queue_depth is None else min(G, config.queue_depth)
 
-    def step(carry, _):
-        res, remaining = carry
+    def step(carry):
+        res, remaining, fuel = carry
         gi = ordering.select_next_gang(
             g, q, res.queue_allocated, fair_share, total, remaining)
         runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0) \
@@ -572,12 +577,13 @@ def run_victim_action(
                     jnp.full((state.running.m,), -1, jnp.int32),
                     res.free, res.device_free, res.releasing_extra,
                     res.device_releasing_extra, res.queue_allocated,
-                    res.queue_allocated_nonpreemptible)
+                    res.queue_allocated_nonpreemptible, res.extended_free)
 
         (success, victims, nodes_t, dev_t, pipe_t, moves,
-         free2, dev2, extra2, extra_dev2, qa2, qan2) = lax.cond(
+         free2, dev2, extra2, extra_dev2, qa2, qan2, ext2) = lax.cond(
              runnable, attempt, skip, None)
         res = res.replace(
+            extended_free=jnp.where(success, ext2, res.extended_free),
             free=jnp.where(success, free2, res.free),
             device_free=jnp.where(success, dev2, res.device_free),
             releasing_extra=jnp.where(success, extra2, res.releasing_extra),
@@ -601,10 +607,67 @@ def run_victim_action(
                                   res.victim_move),
         )
         remaining = remaining.at[gi].set(False)
-        return (res, remaining), None
+        return res, remaining, fuel - 1
 
     remaining0 = g.valid & (g.backoff <= 0) & ~result.allocated
-    (res, _), _ = lax.scan(step, (result, remaining0), None, length=steps)
+
+    # ---- vectorized viability prefilter ---------------------------------
+    # The per-gang scan is the expensive part (a fairness re-sort per
+    # step); gangs that cannot possibly preempt are dropped upfront.
+    # Sound because queue allocation only GROWS within the action, so the
+    # capacity/fair-share gates (re-checked live per attempt) only get
+    # stricter — a gang failing them at action start can never pass later.
+    base = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
+            & (r.gang >= 0))
+    rq = jnp.where(base, r.queue, q.q)
+    cnt_q = jax.ops.segment_sum(base.astype(jnp.int32), rq,
+                                num_segments=q.q + 1)[:q.q]       # [Q]
+    total_cnt = jnp.sum(cnt_q)
+    gq = jnp.maximum(g.queue, 0)
+    if mode == "reclaim":
+        has_cand = (total_cnt - cnt_q[gq]) > 0
+    elif mode == "consolidate":
+        own = jax.ops.segment_sum(
+            base.astype(jnp.int32), jnp.where(base, r.gang, G),
+            num_segments=G + 1)[:G]
+        has_cand = (total_cnt - own) > 0
+    else:  # preempt: a lower-priority candidate in the gang's own queue
+        minprio = jax.ops.segment_min(
+            jnp.where(base, r.priority, BIG), rq,
+            num_segments=q.q + 1)[:q.q]
+        has_cand = minprio[gq] < g.priority
+    task_req_g = jnp.sum(
+        jnp.where(g.task_valid[:, :, None], g.task_req, 0.0), axis=1)
+    gate_np = jax.vmap(
+        lambda qi, tr: _ancestor_gate(
+            q.parent, qi, num_levels,
+            result.queue_allocated_nonpreemptible, q.quota, tr)
+    )(gq, task_req_g)
+    viable = has_cand & jnp.where(~g.preemptible, gate_np, True)
+    if mode == "reclaim":
+        # the fair-share gate must use a LOWER bound of future queue
+        # allocation — reclaim evictions SHRINK allocation as the action
+        # proceeds, so gating on the live value would wrongly exclude
+        # reclaimers whose chain drops under fair share once victims
+        # free up.  Lower bound: current allocation minus everything any
+        # candidate could ever free along the chain.
+        cand_leaf = jax.ops.segment_sum(
+            jnp.where(base[:, None], r.req, 0.0), rq,
+            num_segments=q.q + 1)[:q.q]                        # [Q, R]
+        freeable = jnp.einsum("qa,qr->ar", chain.astype(cand_leaf.dtype),
+                              cand_leaf)
+        qa_lower = jnp.maximum(result.queue_allocated - freeable, 0.0)
+        viable = viable & jax.vmap(
+            lambda qi, tr: _ancestor_gate(
+                q.parent, qi, num_levels, qa_lower,
+                fair_share, tr))(gq, task_req_g)
+    elif mode == "consolidate":
+        viable = viable & g.preemptible
+    remaining0 = remaining0 & viable
+
+    res, _, _ = lax.while_loop(
+        lambda c: jnp.any(c[1]) & (c[2] > 0), step,
+        (result, remaining0, jnp.asarray(steps, jnp.int32)))
     return res
 
 

@@ -65,6 +65,10 @@ class NodeState(struct.PyTreeNode):
     filter_masks: jax.Array      # bool [X, N]
     #: soft bands per (filter-class, node), pre-weighted (K8sPlugins band)
     soft_scores: jax.Array       # f32 [X, N]
+    #: extended scalar resources (MIG profiles etc.) — vocab-encoded
+    #: axis E; E=1 all-zero when the snapshot has none
+    extended_free: jax.Array       # f32 [N, E]
+    extended_releasing: jax.Array  # f32 [N, E]
 
     @property
     def n(self) -> int:
@@ -163,12 +167,18 @@ class GangState(struct.PyTreeNode):
     #: ``actions/common/minimal_job_comparison.go``,
     #: ``podgroup_info`` schedulingConstraintsSignature)
     sig: jax.Array                # i32 [G]
+    #: extended scalar requests per task (MIG profiles; ref migResources)
+    task_extended: jax.Array      # f32 [G, T, E]
+    #: accel devices requested via DRA claims per task (ref draGpuCounts;
+    #: already folded into task_req accel for accounting)
+    task_dra: jax.Array           # i32 [G, T]
     #: the task-type table (Y distinct types, padded)
     type_req: jax.Array           # f32 [Y, R]
     type_selector: jax.Array      # i32 [Y, K]
     type_portion: jax.Array       # f32 [Y]
     type_mem: jax.Array           # f32 [Y]
     type_class: jax.Array         # i32 [Y]
+    type_extended: jax.Array      # f32 [Y, E]
     # --- hierarchical subgroups (ref podgroup_types.go SubGroups +
     # subgroup_info PodSet tree; allocation semantics in
     # actions/common/allocate.go:71-140 allocateSubGroupSet).  Slot 0 is
@@ -308,6 +318,8 @@ class SnapshotIndex:
     uniform_gangs: bool = False
     has_required_topology: bool = True
     has_subgroup_topology: bool = True
+    has_extended_resources: bool = False
+    extended_keys: list[str] = dataclasses.field(default_factory=list)
 
     def node_index(self, name: str) -> int:
         return self.node_names.index(name)
@@ -347,6 +359,13 @@ def build_snapshot(
     L = max(1, len(topo_levels))
     K = max(1, len(selector_keys))
 
+    # extended scalar-resource vocabulary (MIG profiles etc.)
+    ext_keys = sorted(
+        {k for nd in nodes for k in nd.extended}
+        | {k for p in pods for k in p.extended})
+    E = max(1, len(ext_keys))
+    ext_index = {k: i for i, k in enumerate(ext_keys)}
+
     # --- nodes ------------------------------------------------------------
     live_nodes = [n for n in nodes if not n.unschedulable]
     N = _round_up(len(live_nodes), pad)
@@ -368,6 +387,8 @@ def build_snapshot(
     dev_free = np.zeros((N, D), np.float32)
     dev_rel = np.zeros((N, D), np.float32)
     node_dev_mem = np.zeros((N,), np.float32)
+    ext_free = np.zeros((N, E), np.float32)
+    ext_rel = np.zeros((N, E), np.float32)
     accel_mems = [n.accel_memory_gib for n, c in zip(live_nodes, accel_counts)
                   if c > 0]
     #: cluster-min device memory quantifies memory-based requests for
@@ -378,6 +399,8 @@ def build_snapshot(
         node_valid[i] = True
         dev_free[i, :accel_counts[i]] = 1.0
         node_dev_mem[i] = n.accel_memory_gib
+        for ek, ev in n.extended.items():
+            ext_free[i, ext_index[ek]] = ev
         for ki, key in enumerate(selector_keys):
             if key in n.labels:
                 node_labels[i, ki] = value_id(key, n.labels[key])
@@ -440,30 +463,36 @@ def build_snapshot(
         return np.maximum(eff, 0.0)
 
     q_preempt_eff = _inherit(q_preempt_mrt)
-    # ancestor-at-depth table for the LCA walk (top-level first)
-    maxd = int(q_depth.max(initial=0)) + 1
-    anc_at = np.full((Q, maxd), -1, np.int64)
-    for i in range(len(queues)):
-        chain_q, p = [i], int(q_parent[i])
-        while p >= 0:
-            chain_q.append(p)
-            p = int(q_parent[p])
-        for d, qx in enumerate(reversed(chain_q)):
-            anc_at[i, d] = qx
-    # match depth per (victim, reclaimer) pair; start queue = victim-side
-    # child of the LCA (clamped to the victim's leaf; different top-level
-    # queues degenerate to the victim's top-level queue — the "shadow
-    # parent" rule in resolver.go)
-    eq = (anc_at[:, None, :] == anc_at[None, :, :]) & (
-        anc_at[:, None, :] >= 0)                              # [Q, Q, D]
-    match_d = (eq * (np.arange(maxd) + 1)).max(axis=-1) - 1   # [Q, Q]
-    start_d = np.minimum(match_d + 1, q_depth[:, None].astype(np.int64))
-    start_q = np.take_along_axis(
-        np.broadcast_to(anc_at[:, None, :], (Q, Q, maxd)),
-        start_d[:, :, None], axis=2)[:, :, 0]                 # [Q, Q]
-    q_reclaim_inh = _inherit(q_reclaim_mrt)
-    q_reclaim_eff = q_reclaim_inh[np.maximum(start_q, 0)]
-    q_reclaim_eff[start_q < 0] = 0.0
+    if not (q_reclaim_mrt > 0).any():
+        # common case: no queue configures reclaim minruntime — skip the
+        # O(Q^2 x depth) pairwise LCA resolution entirely
+        q_reclaim_eff = np.zeros((Q, Q), np.float32)
+    else:
+        # ancestor-at-depth table for the LCA walk (top-level first)
+        maxd = int(q_depth.max(initial=0)) + 1
+        anc_at = np.full((Q, maxd), -1, np.int64)
+        for i in range(len(queues)):
+            chain_q, p = [i], int(q_parent[i])
+            while p >= 0:
+                chain_q.append(p)
+                p = int(q_parent[p])
+            for d, qx in enumerate(reversed(chain_q)):
+                anc_at[i, d] = qx
+        # match depth per (victim, reclaimer) pair; start queue = the
+        # victim-side child of the LCA (clamped to the victim's leaf;
+        # different top-level queues degenerate to the victim's top-level
+        # queue — the "shadow parent" rule in resolver.go)
+        eq = (anc_at[:, None, :] == anc_at[None, :, :]) & (
+            anc_at[:, None, :] >= 0)                          # [Q, Q, D]
+        match_d = (eq * (np.arange(maxd) + 1)).max(axis=-1) - 1
+        start_d = np.minimum(match_d + 1,
+                             q_depth[:, None].astype(np.int64))
+        start_q = np.take_along_axis(
+            np.broadcast_to(anc_at[:, None, :], (Q, Q, maxd)),
+            start_d[:, :, None], axis=2)[:, :, 0]             # [Q, Q]
+        q_reclaim_inh = _inherit(q_reclaim_mrt)
+        q_reclaim_eff = q_reclaim_inh[np.maximum(start_q, 0)]
+        q_reclaim_eff[start_q < 0] = 0.0
 
     # --- pod groups + tasks ----------------------------------------------
     group_names = [g.name for g in pod_groups]
@@ -510,6 +539,8 @@ def build_snapshot(
         anti_self_level=np.full((G,), -1, np.int32),
         task_type=np.zeros((G, T), np.int32),
         sig=np.zeros((G,), np.int32),
+        task_extended=np.zeros((G, T, E), np.float32),
+        task_dra=np.zeros((G, T), np.int32),
     )
     # --- subgroup tables (slot 0 = implicit default subgroup, so the
     # slot count is max declared subgroups + 1) ----------------------------
@@ -613,6 +644,12 @@ def build_snapshot(
         req_a[:, 0] = np.where(
             por_a > 0, por_a,
             np.where(mem_a > 0, mem_a / min_dev_mem, req_a[:, 0]))
+        # DRA-claimed devices count like whole devices in the accel
+        # accounting (ref draGpuCounts added to total requested GPUs)
+        dra_a = np.fromiter((p.dra_accel_count for p in fpods), np.int32,
+                            nf)
+        req_a[:, 0] += dra_a
+        gk["task_dra"][gi_a, ti_a] = dra_a
         cls_a = np.fromiter((filter_class_of(p) for p in fpods), np.int32,
                             nf)
         gk["task_req"][gi_a, ti_a] = req_a
@@ -642,8 +679,15 @@ def build_snapshot(
                 sel_bytes = gk["task_selector"][i, t].tobytes()
             else:
                 sel_bytes = default_sel_bytes
+            if pod.extended:
+                for ek, ev in pod.extended.items():
+                    gk["task_extended"][i, t, ext_index[ek]] = ev
+                ext_bytes = gk["task_extended"][i, t].tobytes()
+            else:
+                ext_bytes = b""
             tkey = (req_a[j].tobytes(), sel_bytes,
-                    float(por_a[j]), float(mem_a[j]), int(cls_a[j]))
+                    float(por_a[j]), float(mem_a[j]), int(cls_a[j]),
+                    ext_bytes)
             gk["task_type"][i, t] = task_type_index.setdefault(
                 tkey, len(task_type_index))
 
@@ -745,8 +789,15 @@ def build_snapshot(
                 running_pods[j].subgroup or "", 0)] += 1
     for j, pod in enumerate(running_pods):
         running_names[j] = pod.name
-        # --- device occupancy (GPU-group bookkeeping) --------------------
         ni = int(rk["node"][j])
+        if pod.extended and ni >= 0:
+            for ek, ev in pod.extended.items():
+                ei = ext_index[ek]
+                taken = min(ev, float(ext_free[ni, ei]))
+                ext_free[ni, ei] -= taken
+                if pod.status == apis.PodStatus.RELEASING:
+                    ext_rel[ni, ei] += taken
+        # --- device occupancy (GPU-group bookkeeping) --------------------
         if ni >= 0 and (pod.resources.accel > 0 or pod.accel_portion > 0
                         or pod.accel_memory_gib > 0):
             is_frac = pod.accel_portion > 0 or pod.accel_memory_gib > 0
@@ -795,12 +846,16 @@ def build_snapshot(
     gk["type_portion"] = np.zeros((Y,), np.float32)
     gk["type_mem"] = np.zeros((Y,), np.float32)
     gk["type_class"] = np.zeros((Y,), np.int32)
-    for (req_b, sel_b, portion, mem, fclass), tid in task_type_index.items():
+    gk["type_extended"] = np.zeros((Y, E), np.float32)
+    for (req_b, sel_b, portion, mem, fclass,
+         ext_b), tid in task_type_index.items():
         gk["type_req"][tid] = np.frombuffer(req_b, np.float32)
         gk["type_selector"][tid] = np.frombuffer(sel_b, np.int32)
         gk["type_portion"][tid] = portion
         gk["type_mem"][tid] = mem
         gk["type_class"][tid] = fclass
+        if ext_b:
+            gk["type_extended"][tid] = np.frombuffer(ext_b, np.float32)
     sig_index: dict[tuple, int] = {}
     for i in range(len(pod_groups)):
         if not gk["valid"][i]:
@@ -873,6 +928,7 @@ def build_snapshot(
     tvm = gk["task_valid"][:, :, None]
     uniform = (
         not has_fracs
+        and not ext_keys  # extended resources take the per-task path
         # declared subgroups need the per-task path; a gang-level
         # required topology level (slot 0) is native to the whole-gang
         # kernel's single-domain fill
@@ -906,6 +962,8 @@ def build_snapshot(
             device_memory_gib=jnp.asarray(node_dev_mem, dtype),
             filter_masks=jnp.asarray(filter_masks),
             soft_scores=jnp.asarray(soft_scores, dtype),
+            extended_free=jnp.asarray(ext_free, dtype),
+            extended_releasing=jnp.asarray(ext_rel, dtype),
         ),
         queues=QueueState(
             parent=jnp.asarray(q_parent),
@@ -943,5 +1001,7 @@ def build_snapshot(
         has_required_topology=bool((gk["required_level"] >= 0).any()),
         has_subgroup_topology=bool(
             (gk["subgroup_required_level"] >= 0).any()),
+        has_extended_resources=bool(ext_keys),
+        extended_keys=ext_keys,
     )
     return state, index

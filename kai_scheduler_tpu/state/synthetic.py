@@ -28,6 +28,10 @@ def make_cluster(
     task_cpu: float = 1.0,
     task_mem: float = 4.0,
     running_fraction: float = 0.0,
+    #: running gangs take the first half of the leaf queues, pending the
+    #: second half — creates over-quota victims vs under-share
+    #: reclaimers (the reclaim benchmark shape)
+    partition_queues_by_running: bool = False,
     priority_spread: int = 1,
     topology_levels: tuple[int, ...] = (),
     required_level: str | None = None,
@@ -92,8 +96,13 @@ def make_cluster(
     num_running = int(num_gangs * running_fraction)
     node_cursor = 0
     for g in range(num_gangs):
-        queue = leaf_queues[g % len(leaf_queues)]
         running = g < num_running
+        if partition_queues_by_running and len(leaf_queues) >= 2:
+            half = len(leaf_queues) // 2
+            pool = leaf_queues[:half] if running else leaf_queues[half:]
+            queue = pool[g % len(pool)]
+        else:
+            queue = leaf_queues[g % len(leaf_queues)]
         pg = apis.PodGroup(
             name=f"gang-{g}",
             queue=queue,

@@ -1,0 +1,79 @@
+"""Extended scalar resources (MIG profiles) + DRA device counts — ref
+``api/resource_info/gpu_resource_requirment.go`` draGpuCounts /
+migResources and ``plugins/dynamicresources``."""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.ops import drf
+from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.state import build_snapshot
+
+MIG = "nvidia.com/mig-1g.5gb"
+
+
+def run_allocate(state, **cfg):
+    fs = drf.set_fair_share(state, num_levels=1)
+    state = state.replace(queues=state.queues.replace(fair_share=fs))
+    return allocate(state, fs, num_levels=1,
+                    config=AllocateConfig(extended=True, **cfg))
+
+
+def _queue():
+    return [apis.Queue("q", accel=apis.QueueResource(quota=100))]
+
+
+def test_mig_profile_capacity_enforced():
+    """Node exposes 4 MIG slices; three 2-slice gangs -> only two fit,
+    and a node without the profile is never chosen."""
+    nodes = [apis.Node("mig", apis.ResourceVec(0, 64, 256),
+                       extended={MIG: 4.0}),
+             apis.Node("plain", apis.ResourceVec(0, 64, 256))]
+    groups = [apis.PodGroup(f"g{i}", queue="q", min_member=1)
+              for i in range(3)]
+    pods = [apis.Pod(f"p{i}", f"g{i}", apis.ResourceVec(0, 1, 1),
+                     extended={MIG: 2.0}) for i in range(3)]
+    state, idx = build_snapshot(nodes, _queue(), groups, pods)
+    assert idx.has_extended_resources and idx.extended_keys == [MIG]
+    res = run_allocate(state)
+    allocated = np.asarray(res.allocated)
+    assert int(allocated.sum()) == 2
+    pl = np.asarray(res.placements)
+    placed_nodes = {idx.node_names[pl[i, 0]] for i in range(3)
+                    if allocated[i]}
+    assert placed_nodes == {"mig"}
+    assert float(np.asarray(res.extended_free)[0, 0]) == 0.0
+
+
+def test_running_pods_hold_mig_slices():
+    nodes = [apis.Node("mig", apis.ResourceVec(0, 64, 256),
+                       extended={MIG: 4.0})]
+    groups = [apis.PodGroup("old", queue="q", min_member=1,
+                            last_start_timestamp=0.0),
+              apis.PodGroup("new", queue="q", min_member=1)]
+    pods = [apis.Pod("r0", "old", apis.ResourceVec(0, 1, 1),
+                     extended={MIG: 3.0}, status=apis.PodStatus.RUNNING,
+                     node="mig"),
+            apis.Pod("p0", "new", apis.ResourceVec(0, 1, 1),
+                     extended={MIG: 2.0})]
+    state, _ = build_snapshot(nodes, _queue(), groups, pods)
+    res = run_allocate(state)
+    assert not np.asarray(res.allocated)[1]  # only 1 slice free
+
+
+def test_dra_counts_add_to_accel_accounting():
+    """A pod claiming 2 devices via DRA occupies 2 accel units and the
+    BindRequest records the claim allocation."""
+    nodes = [apis.Node("n0", apis.ResourceVec(2, 64, 256))]
+    groups = [apis.PodGroup("g", queue="q", min_member=1),
+              apis.PodGroup("g2", queue="q", min_member=1)]
+    pods = [apis.Pod("p0", "g", apis.ResourceVec(0, 1, 1),
+                     dra_accel_count=2),
+            apis.Pod("p1", "g2", apis.ResourceVec(1, 1, 1))]
+    cluster = Cluster.from_objects(nodes, _queue(), groups, pods)
+    r = Scheduler().run_once(cluster)
+    by_name = {br.pod_name: br for br in r.bind_requests}
+    # the DRA pod takes both devices; the whole-device pod cannot fit
+    assert "p0" in by_name and "p1" not in by_name
+    assert len(by_name["p0"].resource_claim_allocations) == 2
