@@ -76,18 +76,19 @@ class Session:
             sub_topo = (index.has_subgroup_topology
                         or index.has_required_topology)
             ext = index.has_extended_resources
+            dense = index.dense_feasibility
             config = dataclasses.replace(
                 config,
                 allocate=dataclasses.replace(
                     config.allocate, track_devices=devices,
                     uniform_tasks=uniform, subgroup_topology=sub_topo,
-                    extended=ext),
+                    extended=ext, dense_feasibility=dense),
                 victims=dataclasses.replace(
                     config.victims,
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
-                        extended=ext)))
+                        extended=ext, dense_feasibility=dense)))
         fair_share = drf.set_fair_share(
             state, num_levels=config.num_levels, k_value=config.k_value)
         state = state.replace(queues=state.queues.replace(fair_share=fair_share))

@@ -226,6 +226,12 @@ class AllocateConfig:
     #: victim scenarios do not credit evicted pods' extended resources
     #: (conservative for preemptors that need them).
     extended: bool = False
+    #: node feasibility spans the whole node axis (no selectors, filter
+    #: classes, anti-affinity, or topology domains anywhere in the
+    #: snapshot) — lets the whole-gang kernel use a cheap cyclic lane
+    #: rotation instead of the per-attempt feasible-rank cumsum.  Session
+    #: derives this automatically; False is always safe.
+    dense_feasibility: bool = False
     #: skip gangs whose scheduling signature already failed this action —
     #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
     signature_skip: bool = True
@@ -739,12 +745,22 @@ def _attempt_gang_in_domain_uniform(
 
     c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
 
-    # per-lane tie-break by rank WITHIN the feasible set (see the
-    # per-task kernel): spreads equal-scoring nodes across lanes even
-    # inside a confined required-topology domain
-    rank_feas = jnp.cumsum(fit_pipe.astype(jnp.int32)) - 1
-    tie_jitter = (-1e-4 / N) * jnp.mod(rank_feas - lane, N).astype(
-        jnp.float32)                                    # [N]
+    if config.dense_feasibility:
+        # feasibility spans the node axis (no selectors/filters/domains
+        # in the snapshot): a stride-apart cyclic rotation spreads lanes
+        # equally well without the per-attempt cumsum
+        stride = max(1, N // max(1, config.batch_size))
+        tie_jitter = (-1e-4 / N) * jnp.mod(
+            jnp.arange(N) - lane * stride, N).astype(jnp.float32)
+    else:
+        # per-lane tie-break by rank WITHIN the feasible set (see the
+        # per-task kernel): spreads equal-scoring nodes across lanes even
+        # when selectors/filters/domains confine feasibility to a sliver
+        # of the index space (an absolute rotation would collapse every
+        # lane onto the same first feasible node there)
+        rank_feas = jnp.cumsum(fit_pipe.astype(jnp.int32)) - 1
+        tie_jitter = (-1e-4 / N) * jnp.mod(rank_feas - lane, N).astype(
+            jnp.float32)                                # [N]
 
     # ---- scores (one pass; locality band anchored at the best node) -----
     scores0 = score_nodes_for_task(

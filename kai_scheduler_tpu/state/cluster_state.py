@@ -320,6 +320,9 @@ class SnapshotIndex:
     has_subgroup_topology: bool = True
     has_extended_resources: bool = False
     extended_keys: list[str] = dataclasses.field(default_factory=list)
+    #: feasibility spans the whole node axis: no selectors, filter
+    #: classes, anti-affinity, or topology constraints in the snapshot
+    dense_feasibility: bool = False
 
     def node_index(self, name: str) -> int:
         return self.node_names.index(name)
@@ -1003,5 +1006,9 @@ def build_snapshot(
             (gk["subgroup_required_level"] >= 0).any()),
         has_extended_resources=bool(ext_keys),
         extended_keys=ext_keys,
+        dense_feasibility=(
+            not selector_keys and len(filter_specs) == 1
+            and bool((gk["anti_self_level"] < 0).all())
+            and bool((gk["subgroup_required_level"] < 0).all())),
     )
     return state, index
