@@ -163,6 +163,64 @@ def bench_reclaim(iters: int) -> dict:
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
 
 
+def bench_e2e(iters: int) -> dict:
+    """Full production cycle — snapshot → default action pipeline →
+    commit, measured as ONE wall-clock number per cycle (the VERDICT r2
+    gap: the kernel met the bar while the host path cost seconds).
+
+    Runs on a SATURATED shape — running pods fill the cluster exactly
+    (40k running pods x 1 accel = 10k nodes x 4), the 10k pending pods sit
+    in under-served queues — so allocate fails capacity, reclaim finds
+    real victims, and preempt/consolidation/stale all execute: the
+    worst-case production cycle.  Cluster state is restored between
+    cycles outside the timed region.  Reports the host/device split
+    alongside p99.
+    """
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    from kai_scheduler_tpu.state import make_cluster
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=10_000, node_accel=4.0, num_gangs=6250, tasks_per_gang=8,
+        running_fraction=0.8, queue_accel_quota=1000.0,
+        partition_queues_by_running=True)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    # restorable bits mutated by a cycle: pod status/devices, group flags
+    pod_state = {p.name: (p.status, p.node, tuple(p.accel_devices))
+                 for p in pods}
+    grp_state = {g.name: (g.fit_failures, g.unschedulable, g.phase,
+                          g.last_start_timestamp) for g in groups}
+
+    def restore():
+        cluster.bind_requests.clear()
+        cluster.restarting.clear()
+        for p in pods:
+            st, nd, devs = pod_state[p.name]
+            p.status, p.node, p.accel_devices = st, nd, list(devs)
+        for g in groups:
+            (g.fit_failures, g.unschedulable, g.phase,
+             g.last_start_timestamp) = grp_state[g.name]
+
+    sched = Scheduler()
+    res = sched.run_once(cluster)  # compile
+    times, opens, commits = [], [], []
+    for _ in range(iters):
+        restore()
+        t0 = time.perf_counter()
+        res = sched.run_once(cluster)
+        times.append(time.perf_counter() - t0)
+        opens.append(res.open_seconds)
+        commits.append(res.commit_seconds)
+    p99 = _p99(times)
+    return {"metric": ("END-TO-END cycle p99 @ 10k nodes x 50k pods "
+                       "(snapshot+actions+commit; "
+                       f"{len(res.bind_requests)} binds, "
+                       f"{len(res.evictions)} evictions; "
+                       f"open {_p99(opens):.0f} ms, "
+                       f"commit+sync {_p99(commits):.0f} ms)"),
+            "value": round(p99, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
+
+
 CONFIGS = {
     "1": bench_fairshare, "fairshare": bench_fairshare,
     "2": bench_scoring, "scoring": bench_scoring,
@@ -170,6 +228,7 @@ CONFIGS = {
     "4": bench_topology, "topology": bench_topology,
     "5": bench_reclaim, "reclaim": bench_reclaim,
     "headline": bench_headline,
+    "e2e": bench_e2e,
 }
 
 
@@ -179,7 +238,8 @@ def main() -> None:
                            "gang" if quick else "headline")
     iters = int(os.environ.get("BENCH_ITERS", 3 if quick else 10))
     if which == "all":
-        for name in ("fairshare", "scoring", "gang", "topology", "reclaim"):
+        for name in ("fairshare", "scoring", "gang", "topology", "reclaim",
+                     "e2e"):
             print(json.dumps(CONFIGS[name](iters)), file=sys.stderr)
         print(json.dumps(bench_headline(iters)))
         return

@@ -43,9 +43,16 @@ class CycleResult:
         default_factory=list)
     #: the on-device commit set threaded through the action pipeline
     tensors: AllocationResult | None = None
-    #: action name -> wall seconds (ref per-action latency metrics)
+    #: action name -> wall seconds (ref per-action latency metrics).
+    #: NOTE: kernels dispatch async — an action's time is dispatch cost;
+    #: device execution overlaps and is absorbed by ``commit_seconds``
+    #: (the first host transfer syncs).
     action_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
     session_seconds: float = 0.0
+    #: Session.open wall seconds (host snapshot build + DRF dispatch)
+    open_seconds: float = 0.0
+    #: tensors→BindRequests/evictions + API writes wall seconds
+    commit_seconds: float = 0.0
 
 
 class Action(Protocol):
@@ -218,9 +225,10 @@ class Scheduler:
             *self._shard_filter(*cluster.snapshot_lists()),
             config=self.config.session,
             now=cluster.now, queue_usage=queue_usage)
-        metrics.open_session_latency.observe(
-            value=time.perf_counter() - t0)
+        open_s = time.perf_counter() - t0
+        metrics.open_session_latency.observe(value=open_s)
         result = CycleResult(tensors=init_result(session.state))
+        result.open_seconds = open_s
         for name, action in self._actions:
             ta = time.perf_counter()
             action(session, result)
@@ -229,6 +237,7 @@ class Scheduler:
                 name, value=result.action_seconds[name])
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
+        tc = time.perf_counter()
         result.bind_requests = session.bind_requests_from(result.tensors)
         result.evictions = session.evictions_from(
             result.tensors.victim, result.tensors.victim_move)
@@ -245,6 +254,7 @@ class Scheduler:
                     rebind = session.move_bind_request(pod, ev.move_to)
                     result.move_bind_requests.append(rebind)
                     cluster.create_bind_request(rebind)
+        result.commit_seconds = time.perf_counter() - tc
         self._record_fit_status(cluster, session, result)
         self._record_metrics(session, result)
         result.session_seconds = time.perf_counter() - t0

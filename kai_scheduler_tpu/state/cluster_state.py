@@ -23,6 +23,7 @@ capacity growth only triggers recompiles at padded-size boundaries.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -283,13 +284,6 @@ def _round_up(n: int, multiple: int = 8) -> int:
 _LEADER_ROLES = ("master", "launcher", "head")
 
 
-def _task_order_key(pod: apis.Pod):
-    role = (pod.labels.get("training.kubeflow.org/job-role")
-            or pod.labels.get("ray.io/node-type"))
-    return (0 if role in _LEADER_ROLES else 1,
-            -pod.priority, pod.creation_timestamp, pod.name)
-
-
 # ---------------------------------------------------------------------------
 # Snapshot builder (host): api objects -> ClusterState
 # ---------------------------------------------------------------------------
@@ -326,6 +320,24 @@ class SnapshotIndex:
 
     def node_index(self, name: str) -> int:
         return self.node_names.index(name)
+
+    # object-array views of the name tables, built once per snapshot so
+    # the commit path gathers names columnar instead of per-row indexing
+    @functools.cached_property
+    def task_names_arr(self) -> "np.ndarray":
+        return np.array(self.task_names, dtype=object)
+
+    @functools.cached_property
+    def node_names_arr(self) -> "np.ndarray":
+        return np.array(self.node_names, dtype=object)
+
+    @functools.cached_property
+    def gang_names_arr(self) -> "np.ndarray":
+        return np.array(self.gang_names, dtype=object)
+
+    @functools.cached_property
+    def running_pod_names_arr(self) -> "np.ndarray":
+        return np.array(self.running_pod_names, dtype=object)
 
 
 def build_snapshot(
@@ -574,21 +586,13 @@ def build_snapshot(
         return spec_index[key]
 
     node_idx0 = {name: i for i, name in enumerate(node_names)}
-    task_type_index: dict[tuple, int] = {}
     task_names: list[list[str | None]] = [[None] * T for _ in range(G)]
-    flat_tasks: list[tuple[int, int, apis.Pod]] = []
     for i, g in enumerate(pod_groups):
-        tasks = pending_by_group[g.name]
-        # task-order semantics: kubeflow/ray leader pods first (ref
-        # plugins/kubeflow + plugins/ray TaskOrderFn on the job-role /
-        # node-type labels), then priority desc, then creation asc
-        # (taskorder plugin)
-        tasks.sort(key=_task_order_key)
         gk["queue"][i] = q_index.get(g.queue, 0)
         gk["min_member"][i] = g.min_member
         gk["priority"][i] = g.priority
         gk["preemptible"][i] = g.preemptibility == apis.Preemptibility.PREEMPTIBLE
-        gk["valid"][i] = bool(tasks)
+        gk["valid"][i] = bool(pending_by_group[g.name])
         gk["creation_order"][i] = i
         # the UnschedulableOnNodePool condition keeps the gang out of the
         # cycle until cleared (ref cluster_info skipping marked groups)
@@ -622,77 +626,121 @@ def build_snapshot(
                 if gk["subgroup_required_level"][i, si] < 0:
                     gk["subgroup_required_level"][i, si] = \
                         gk["required_level"][i]
-        for t, pod in enumerate(tasks[:T]):
-            flat_tasks.append((i, t, pod))
-            task_names[i][t] = pod.name
 
-    # --- bulk task-field assignment (one vectorized write per field
-    # instead of per-pod numpy scalar writes — the host snapshot must
-    # stay a small fraction of the device cycle at 50k pods) ------------
-    if flat_tasks:
-        nf = len(flat_tasks)
-        gi_a = np.fromiter((f[0] for f in flat_tasks), np.int64, nf)
-        ti_a = np.fromiter((f[1] for f in flat_tasks), np.int64, nf)
-        fpods = [f[2] for f in flat_tasks]
-        req_a = np.array([p.resources.as_tuple() for p in fpods],
-                         np.float32)
-        por_a = np.fromiter((p.accel_portion for p in fpods), np.float32,
-                            nf)
-        mem_a = np.fromiter((p.accel_memory_gib for p in fpods),
-                            np.float32, nf)
-        # fractional / memory-based requests carry their share in the
-        # accel slot so queue & node totals stay consistent (memory-based
-        # quantified against the cluster-min device memory, ref
-        # GetTasksToAllocateInitResource MinNodeGPUMemory)
-        req_a[:, 0] = np.where(
-            por_a > 0, por_a,
-            np.where(mem_a > 0, mem_a / min_dev_mem, req_a[:, 0]))
-        # DRA-claimed devices count like whole devices in the accel
-        # accounting (ref draGpuCounts added to total requested GPUs)
-        dra_a = np.fromiter((p.dra_accel_count for p in fpods), np.int32,
-                            nf)
-        req_a[:, 0] += dra_a
-        gk["task_dra"][gi_a, ti_a] = dra_a
-        cls_a = np.fromiter((filter_class_of(p) for p in fpods), np.int32,
-                            nf)
-        gk["task_req"][gi_a, ti_a] = req_a
+    # --- task intake: one global lexsort + a type-table gather -----------
+    # Task-order semantics (ref plugins/kubeflow + plugins/ray leader pods
+    # first on the job-role / node-type labels, then priority desc,
+    # creation asc, name — the taskorder plugin) run as ONE vectorized
+    # lexsort over all pending pods instead of a per-gang Python sort, and
+    # every per-task field is an O(distinct-spec) encode + O(tasks) gather
+    # — the host snapshot must stay a small fraction of the device cycle
+    # at 50k pods.
+    all_pend: list[apis.Pod] = []
+    for g in pod_groups:
+        all_pend.extend(pending_by_group[g.name])
+    counts = np.fromiter(
+        (len(pending_by_group[g.name]) for g in pod_groups), np.int64,
+        len(pod_groups)) if pod_groups else np.zeros((0,), np.int64)
+    nf = len(all_pend)
+    task_type_index: dict[tuple, int] = {}
+    if nf:
+        gidx = np.repeat(np.arange(len(pod_groups)), counts)
+        leader = np.fromiter(
+            ((p.labels.get("training.kubeflow.org/job-role")
+              or p.labels.get("ray.io/node-type")) not in _LEADER_ROLES
+             for p in all_pend), bool, nf)
+        prio_a = np.fromiter((p.priority for p in all_pend), np.int64, nf)
+        crea_a = np.fromiter((p.creation_timestamp for p in all_pend),
+                             np.float64, nf)
+        names_a = np.array([p.name for p in all_pend])
+        # gidx is already non-decreasing (groups appended in order), so
+        # the stable lexsort only permutes within each gang
+        order = np.lexsort((names_a, crea_a, -prio_a, leader, gidx))
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        gi_a = gidx
+        ti_a = np.arange(nf) - starts[gidx]
+        if (ti_a >= T).any():
+            raise AssertionError("task slots exceed padded T")  # unreachable
+
+        # distinct task specs: one dict probe per pod, everything heavier
+        # once per distinct type
+        def _tkey(p: apis.Pod) -> tuple:
+            return (
+                p.resources.as_tuple(),
+                tuple(sorted(p.node_selector.items()))
+                if p.node_selector else (),
+                p.accel_portion, p.accel_memory_gib, p.dra_accel_count,
+                filter_class_of(p),
+                tuple(sorted(p.extended.items())) if p.extended else ())
+
+        tid = np.fromiter(
+            (task_type_index.setdefault(_tkey(p), len(task_type_index))
+             for p in all_pend), np.int64, nf)
+        Yn = len(task_type_index)
+        t_req = np.zeros((Yn, R), np.float32)
+        t_sel = np.full((Yn, K), -1, np.int32)
+        t_por = np.zeros((Yn,), np.float32)
+        t_mem = np.zeros((Yn,), np.float32)
+        t_cls = np.zeros((Yn,), np.int32)
+        t_ext = np.zeros((Yn, E), np.float32)
+        t_dra = np.zeros((Yn,), np.int32)
+        for (req_t, sel_items, por, memg, dra, cls,
+             ext_items), y in task_type_index.items():
+            t_req[y] = req_t
+            # fractional / memory-based requests carry their share in the
+            # accel slot so queue & node totals stay consistent
+            # (memory-based quantified against the cluster-min device
+            # memory, ref GetTasksToAllocateInitResource MinNodeGPUMemory);
+            # DRA-claimed devices count like whole devices (ref
+            # draGpuCounts added to total requested GPUs)
+            if por > 0:
+                t_req[y, 0] = por
+            elif memg > 0:
+                t_req[y, 0] = memg / min_dev_mem
+            t_req[y, 0] += dra
+            t_por[y], t_mem[y], t_cls[y], t_dra[y] = por, memg, cls, dra
+            for k2, v2 in sel_items:
+                t_sel[y, selector_keys.index(k2)] = value_id(k2, v2)
+            for k2, v2 in ext_items:
+                t_ext[y, ext_index[k2]] = v2
+
+        tid_s = tid[order]
         gk["task_valid"][gi_a, ti_a] = True
-        gk["task_portion"][gi_a, ti_a] = por_a
-        gk["task_accel_mem"][gi_a, ti_a] = mem_a
-        gk["task_filter_class"][gi_a, ti_a] = cls_a
-        default_sel_bytes = np.full((K,), -1, np.int32).tobytes()
-        for j, (i, t, pod) in enumerate(flat_tasks):
-            if sub_slot[i]:
-                gk["task_subgroup"][i, t] = sub_slot[i].get(
-                    pod.subgroup or "", 0)
-            if pod.nominated_node is not None:
-                gk["task_nominated"][i, t] = node_idx0.get(
-                    pod.nominated_node, -1)
-            if pod.pod_affinity:
-                asl = node_filters.anti_self_level(pod, topo_levels, L)
-                if asl >= 0:
-                    cur = gk["anti_self_level"][i]
-                    gk["anti_self_level"][i] = (asl if cur < 0
-                                                else min(cur, asl))
-            if pod.node_selector:
-                for ki, key in enumerate(selector_keys):
-                    if key in pod.node_selector:
-                        gk["task_selector"][i, t, ki] = value_id(
-                            key, pod.node_selector[key])
-                sel_bytes = gk["task_selector"][i, t].tobytes()
-            else:
-                sel_bytes = default_sel_bytes
-            if pod.extended:
-                for ek, ev in pod.extended.items():
-                    gk["task_extended"][i, t, ext_index[ek]] = ev
-                ext_bytes = gk["task_extended"][i, t].tobytes()
-            else:
-                ext_bytes = b""
-            tkey = (req_a[j].tobytes(), sel_bytes,
-                    float(por_a[j]), float(mem_a[j]), int(cls_a[j]),
-                    ext_bytes)
-            gk["task_type"][i, t] = task_type_index.setdefault(
-                tkey, len(task_type_index))
+        gk["task_req"][gi_a, ti_a] = t_req[tid_s]
+        gk["task_selector"][gi_a, ti_a] = t_sel[tid_s]
+        gk["task_portion"][gi_a, ti_a] = t_por[tid_s]
+        gk["task_accel_mem"][gi_a, ti_a] = t_mem[tid_s]
+        gk["task_filter_class"][gi_a, ti_a] = t_cls[tid_s]
+        gk["task_extended"][gi_a, ti_a] = t_ext[tid_s]
+        gk["task_dra"][gi_a, ti_a] = t_dra[tid_s]
+        gk["task_type"][gi_a, ti_a] = tid_s
+        names_obj = names_a.astype(object)[order]
+        tnames_arr = np.full((G, T), None, object)
+        tnames_arr[gi_a, ti_a] = names_obj
+        task_names = tnames_arr.tolist()
+
+        # sparse per-pod attributes: touch only the pods that carry them
+        nom = np.fromiter(
+            ((-1 if p.nominated_node is None
+              else node_idx0.get(p.nominated_node, -1))
+             for p in all_pend), np.int32, nf)
+        gk["task_nominated"][gi_a, ti_a] = nom[order]
+        has_subs_g = np.fromiter((bool(s) for s in sub_slot), bool, G)
+        if has_subs_g.any():
+            subcol = np.zeros((nf,), np.int32)
+            for j in np.nonzero(has_subs_g[gidx])[0].tolist():
+                subcol[j] = sub_slot[gidx[j]].get(
+                    all_pend[j].subgroup or "", 0)
+            gk["task_subgroup"][gi_a, ti_a] = subcol[order]
+        paff = np.fromiter((bool(p.pod_affinity) for p in all_pend), bool,
+                           nf)
+        for j in np.nonzero(paff)[0].tolist():
+            asl = node_filters.anti_self_level(all_pend[j], topo_levels, L)
+            if asl >= 0:
+                i = gidx[j]
+                cur = gk["anti_self_level"][i]
+                gk["anti_self_level"][i] = (asl if cur < 0
+                                            else min(cur, asl))
 
     # --- running pods -----------------------------------------------------
     # Pods whose node is missing from the snapshot (cordoned/deleted) keep
@@ -790,21 +838,70 @@ def build_snapshot(
         for j in np.nonzero(active & has_subs[gsafe])[0]:
             sub_running[r_grp[j], sub_slot[r_grp[j]].get(
                 running_pods[j].subgroup or "", 0)] += 1
-    for j, pod in enumerate(running_pods):
-        running_names[j] = pod.name
-        ni = int(rk["node"][j])
-        if pod.extended and ni >= 0:
+    if Mu:
+        running_names[:Mu] = [p.name for p in running_pods]
+        # --- device occupancy (GPU-group bookkeeping) --------------------
+        # Fast path: whole-device pods with no recorded device list on
+        # nodes carrying no fractional/pinned pods get first-fit devices —
+        # which is exactly a contiguous per-node assignment in pod order,
+        # computed as one grouped prefix sum.  Fractional pods, pods with
+        # recorded devices, and every pod sharing a node with one take the
+        # per-pod path (order within a node matches the old sequential
+        # first-fit exactly: node sets are disjoint between the paths).
+        whole_k = np.rint(r_req[:, 0] * (r_por <= 0) * (r_mem <= 0)
+                          ).astype(np.int64)
+        has_dev = np.fromiter((bool(p.accel_devices) for p in running_pods),
+                              bool, Mu)
+        has_ext = np.fromiter((bool(p.extended) for p in running_pods),
+                              bool, Mu)
+        on = r_node >= 0
+        frac = (r_por > 0) | (r_mem > 0)
+        touches = on & (frac | (whole_k > 0))
+        special = touches & (frac | has_dev)
+        node_special = np.zeros((N,), bool)
+        node_special[r_node[special]] = True
+        vec = touches & ~special & ~node_special[np.maximum(r_node, 0)]
+        # extended scalars: only pods that carry them
+        for j in np.nonzero(has_ext & on)[0].tolist():
+            pod = running_pods[j]
+            ni = int(r_node[j])
             for ek, ev in pod.extended.items():
                 ei = ext_index[ek]
                 taken = min(ev, float(ext_free[ni, ei]))
                 ext_free[ni, ei] -= taken
                 if pod.status == apis.PodStatus.RELEASING:
                     ext_rel[ni, ei] += taken
-        # --- device occupancy (GPU-group bookkeeping) --------------------
-        if ni >= 0 and (pod.resources.accel > 0 or pod.accel_portion > 0
-                        or pod.accel_memory_gib > 0):
-            is_frac = pod.accel_portion > 0 or pod.accel_memory_gib > 0
-            if is_frac:
+        vj = np.nonzero(vec)[0]
+        if len(vj):
+            accel_counts_a = np.asarray(accel_counts, np.int64)
+            vn = r_node[vj]
+            ordv = np.argsort(vn, kind="stable")
+            vj, vn = vj[ordv], vn[ordv]
+            vk = whole_k[vj]
+            cum = np.cumsum(vk) - vk
+            first = np.ones(len(vj), bool)
+            first[1:] = vn[1:] != vn[:-1]
+            grp = np.cumsum(first) - 1
+            off = cum - cum[np.nonzero(first)[0]][grp]
+            k_eff = np.clip(accel_counts_a[vn] - off, 0, vk)
+            end = off + k_eff
+            rk["devices_mask"][vj] = (
+                (np.int64(1) << end) - (np.int64(1) << off)).astype(np.int32)
+            rk["accel_held"][vj] = k_eff.astype(np.float32)
+            tot = int(k_eff.sum())
+            if tot:
+                rep = np.repeat(np.arange(len(vj)), k_eff)
+                dpos = (np.arange(tot)
+                        - np.repeat(np.cumsum(k_eff) - k_eff, k_eff)
+                        + np.repeat(off, k_eff))
+                nrep = vn[rep]
+                dev_free[nrep, dpos] = 0.0
+                relm = r_rel[vj][rep]
+                dev_rel[nrep[relm], dpos[relm]] += 1.0
+        for j in np.nonzero(touches & ~vec)[0].tolist():
+            pod = running_pods[j]
+            ni = int(r_node[j])
+            if frac[j]:
                 p = (pod.accel_portion if pod.accel_portion > 0
                      else pod.accel_memory_gib / max(node_dev_mem[ni], 1e-6))
                 if pod.accel_devices:
@@ -819,22 +916,21 @@ def build_snapshot(
                 rk["device"][j] = d0
                 rk["accel_held"][j] = p
             else:
-                k = int(round(pod.resources.accel))
-                if k > 0:
-                    if pod.accel_devices:
-                        devs = list(pod.accel_devices)[:k]
-                    else:
-                        devs = list(np.nonzero(
-                            dev_free[ni] >= 1.0 - 1e-6)[0][:k])
-                    mask = 0
-                    for d0 in devs:
-                        taken = min(1.0, dev_free[ni, d0])
-                        dev_free[ni, d0] -= taken
-                        if pod.status == apis.PodStatus.RELEASING:
-                            dev_rel[ni, d0] += taken
-                        mask |= 1 << int(d0)
-                    rk["devices_mask"][j] = mask
-                    rk["accel_held"][j] = float(len(devs))
+                k = int(whole_k[j])
+                if pod.accel_devices:
+                    devs = list(pod.accel_devices)[:k]
+                else:
+                    devs = list(np.nonzero(
+                        dev_free[ni] >= 1.0 - 1e-6)[0][:k])
+                mask = 0
+                for d0 in devs:
+                    taken = min(1.0, dev_free[ni, d0])
+                    dev_free[ni, d0] -= taken
+                    if pod.status == apis.PodStatus.RELEASING:
+                        dev_rel[ni, d0] += taken
+                    mask |= 1 << int(d0)
+                rk["devices_mask"][j] = mask
+                rk["accel_held"][j] = float(len(devs))
     for i, grp_obj in enumerate(pod_groups):
         if grp_obj.stale_since is not None:
             gk["stale_s"][i] = max(0.0, now - grp_obj.stale_since)
@@ -850,30 +946,35 @@ def build_snapshot(
     gk["type_mem"] = np.zeros((Y,), np.float32)
     gk["type_class"] = np.zeros((Y,), np.int32)
     gk["type_extended"] = np.zeros((Y, E), np.float32)
-    for (req_b, sel_b, portion, mem, fclass,
-         ext_b), tid in task_type_index.items():
-        gk["type_req"][tid] = np.frombuffer(req_b, np.float32)
-        gk["type_selector"][tid] = np.frombuffer(sel_b, np.int32)
-        gk["type_portion"][tid] = portion
-        gk["type_mem"][tid] = mem
-        gk["type_class"][tid] = fclass
-        if ext_b:
-            gk["type_extended"][tid] = np.frombuffer(ext_b, np.float32)
-    sig_index: dict[tuple, int] = {}
-    for i in range(len(pod_groups)):
-        if not gk["valid"][i]:
-            continue
-        tids = tuple(sorted(
-            (int(gk["task_type"][i, t]), int(gk["task_subgroup"][i, t]))
-            for t in range(T) if gk["task_valid"][i, t]))
-        subs = tuple(
-            (int(gk["subgroup_min_needed"][i, s]),
-             int(gk["subgroup_required_level"][i, s]))
-            for s in range(S) if gk["subgroup_valid"][i, s])
-        skey = (int(gk["queue"][i]), tids, subs, int(gk["min_needed"][i]),
-                int(gk["required_level"][i]), int(gk["preferred_level"][i]),
-                int(gk["anti_self_level"][i]), bool(gk["preemptible"][i]))
-        gk["sig"][i] = sig_index.setdefault(skey, len(sig_index))
+    if nf:
+        gk["type_req"][:Yn] = t_req
+        gk["type_selector"][:Yn] = t_sel
+        gk["type_portion"][:Yn] = t_por
+        gk["type_mem"][:Yn] = t_mem
+        gk["type_class"][:Yn] = t_cls
+        gk["type_extended"][:Yn] = t_ext
+    # scheduling-constraints signature (ref minimal_job_comparison.go):
+    # equivalent gangs = identical rows of [sorted (type,subgroup) multiset
+    # | per-subgroup (min_needed, required_level) | queue/quorum/topology
+    # scalars] — one np.unique instead of a per-gang Python tuple build
+    big = np.int64(Y) * (S + 1) + 1
+    comp = np.where(gk["task_valid"],
+                    gk["task_type"].astype(np.int64) * (S + 1)
+                    + gk["task_subgroup"], big)
+    comp.sort(axis=1)
+    sub_mn = np.where(gk["subgroup_valid"], gk["subgroup_min_needed"], -2)
+    sub_rl = np.where(gk["subgroup_valid"], gk["subgroup_required_level"],
+                      -2)
+    sig_mat = np.concatenate([
+        comp, sub_mn, sub_rl,
+        gk["queue"][:, None].astype(np.int64),
+        gk["min_needed"][:, None], gk["required_level"][:, None],
+        gk["preferred_level"][:, None], gk["anti_self_level"][:, None],
+        gk["preemptible"][:, None].astype(np.int64),
+        (~gk["valid"][:, None]).astype(np.int64),
+    ], axis=1, dtype=np.int64)
+    _, inv = np.unique(sig_mat, axis=0, return_inverse=True)
+    gk["sig"] = inv.astype(np.int32)
 
     # --- derived node free / releasing (vectorized scatter-adds) ---------
     node_used = np.zeros((N, R), np.float32)
