@@ -200,6 +200,7 @@ def bench_e2e(iters: int) -> dict:
             (g.fit_failures, g.unschedulable, g.phase,
              g.last_start_timestamp) = grp_state[g.name]
 
+    import numpy as np
     sched = Scheduler()
     res = sched.run_once(cluster)  # compile
     times, opens, commits = [], [], []
@@ -211,10 +212,48 @@ def bench_e2e(iters: int) -> dict:
         opens.append(res.open_seconds)
         commits.append(res.commit_seconds)
     p99 = _p99(times)
-    return {"metric": ("END-TO-END cycle p99 @ 10k nodes x 50k pods "
-                       "(snapshot+actions+commit; "
+    pipelined = int(np.asarray(res.tensors.pipelined).sum())
+    return {"metric": ("END-TO-END cycle p99 @ 10k nodes x 50k pods, "
+                       "saturated worst case (snapshot+actions+commit; "
                        f"{len(res.bind_requests)} binds, "
+                       f"{pipelined} pipelined onto victim capacity, "
                        f"{len(res.evictions)} evictions; "
+                       f"open {_p99(opens):.0f} ms, "
+                       f"commit+sync {_p99(commits):.0f} ms)"),
+            "value": round(p99, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
+
+
+def bench_e2e_alloc(iters: int) -> dict:
+    """Full cycle on the HEADLINE allocate shape (empty cluster, 50k
+    pending) — isolates the host path (snapshot build + commit
+    translation) around the allocate kernel; victim actions run but find
+    nothing.  This is the shape VERDICT r2 measured at ~9 s host cost."""
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    from kai_scheduler_tpu.state import make_cluster
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=10_000, node_accel=8.0, num_gangs=6250, tasks_per_gang=8)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    grp_state = {g.name: (g.fit_failures, g.unschedulable, g.phase,
+                          g.last_start_timestamp) for g in groups}
+    sched = Scheduler()
+    res = sched.run_once(cluster)  # compile
+    times, opens, commits = [], [], []
+    for _ in range(iters):
+        cluster.bind_requests.clear()
+        for g in groups:
+            (g.fit_failures, g.unschedulable, g.phase,
+             g.last_start_timestamp) = grp_state[g.name]
+        t0 = time.perf_counter()
+        res = sched.run_once(cluster)
+        times.append(time.perf_counter() - t0)
+        opens.append(res.open_seconds)
+        commits.append(res.commit_seconds)
+    p99 = _p99(times)
+    return {"metric": ("END-TO-END cycle p99 @ 10k nodes x 50k pending "
+                       "pods, allocate-heavy (snapshot+actions+commit; "
+                       f"{len(res.bind_requests)} binds; "
                        f"open {_p99(opens):.0f} ms, "
                        f"commit+sync {_p99(commits):.0f} ms)"),
             "value": round(p99, 3), "unit": "ms",
@@ -229,6 +268,7 @@ CONFIGS = {
     "5": bench_reclaim, "reclaim": bench_reclaim,
     "headline": bench_headline,
     "e2e": bench_e2e,
+    "e2e_alloc": bench_e2e_alloc,
 }
 
 
@@ -239,7 +279,7 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", 3 if quick else 10))
     if which == "all":
         for name in ("fairshare", "scoring", "gang", "topology", "reclaim",
-                     "e2e"):
+                     "e2e", "e2e_alloc"):
             print(json.dumps(CONFIGS[name](iters)), file=sys.stderr)
         print(json.dumps(bench_headline(iters)))
         return

@@ -1,0 +1,178 @@
+"""Config layering — a YAML/JSON document merged over compiled defaults.
+
+The reference loads a ``SchedulerConfiguration`` document from a
+ConfigMap and merges it over built-in defaults
+(``conf_util/scheduler_conf_util.go:36-90``: the default actions string
+and plugin tiers; absent fields keep defaults), with a pflag CLI on top
+(``cmd/scheduler/app/options/options.go:90-131``).  This module is that
+stack for the TPU scheduler: ``load_config`` parses the same document
+shape (``actions`` string, ``tiers`` with per-plugin ``arguments``,
+``queueDepthPerAction``, usage-db / kValue knobs) into a
+:class:`~kai_scheduler_tpu.framework.scheduler.SchedulerConfig`, and
+``kai_scheduler_tpu.__main__`` is the CLI entry point.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from .framework.scheduler import SchedulerConfig, action_names
+from .framework.session import SessionConfig
+from .ops.scoring import PlacementConfig
+from .plugins import registry
+
+#: ref ``conf_util/scheduler_conf_util.go:37`` defaultSchedulerConf
+DEFAULT_ACTIONS = "allocate, consolidation, reclaim, preempt, stalegangeviction"
+
+
+def parse_document(text: str) -> dict:
+    """Parse a YAML (or JSON — a YAML subset) config document."""
+    import yaml
+    doc = yaml.safe_load(text)
+    if doc is None:
+        return {}
+    if not isinstance(doc, dict):
+        raise ValueError("scheduler config document must be a mapping")
+    return doc
+
+
+def _parse_actions(spec: str) -> tuple[str, ...]:
+    acts = tuple(s for s in spec.replace(",", " ").split() if s)
+    known = set(action_names())
+    unknown = [a for a in acts if a not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown actions {unknown}; registered: {sorted(known)}")
+    return acts
+
+
+def _merge_tiers(doc_tiers: list, session: SessionConfig) -> SessionConfig:
+    """Apply the ConfigMap ``tiers`` list: plugin ORDER/selection for the
+    score registry, plus per-plugin ``arguments`` (nodeplacement's
+    binpack/spread — ref ``conf_util/scheduler_conf_util.go:54-57`` —
+    gpupack/gpuspread, and proportion's kValue)."""
+    names: list[str] = []
+    placement = session.allocate.placement
+    k_value = session.k_value
+    for tier in doc_tiers or []:
+        for plugin in tier.get("plugins", []):
+            name = plugin["name"]
+            args = plugin.get("arguments") or {}
+            if name == "nodeplacement":
+                placement = dataclasses.replace(
+                    placement,
+                    binpack_accel=args.get("gpu", "binpack") == "binpack",
+                    binpack_cpu=args.get("cpu", "binpack") == "binpack")
+            elif name == "gpupack":
+                placement = dataclasses.replace(placement, device_pack=True)
+            elif name == "gpuspread":
+                placement = dataclasses.replace(placement,
+                                                device_pack=False)
+            elif name == "proportion":
+                k_value = float(args.get("kValue", k_value))
+            names.append(name)
+    # score-registry plugins keep the configured order; the rest of the
+    # reference's plugin list is compiled into the kernels (predicates,
+    # topology, elastic, ... — see SURVEY §2.5 rows) and participates
+    # whenever the snapshot carries the matching constraints.
+    scoreable = set(registry.available_plugins())
+    tiers = tuple(n for n in names if n in scoreable)
+    if tiers:
+        placement = dataclasses.replace(placement, tiers=tiers)
+    return dataclasses.replace(
+        session, k_value=k_value,
+        allocate=dataclasses.replace(session.allocate, placement=placement),
+        # VictimConfig.placement is the victim solver's AllocateConfig;
+        # the strategy knobs sit one level deeper
+        victims=dataclasses.replace(
+            session.victims,
+            placement=dataclasses.replace(session.victims.placement,
+                                          placement=placement)))
+
+
+def load_config(doc: dict | str | None,
+                base: SchedulerConfig | None = None) -> SchedulerConfig:
+    """Merge a scheduler-configuration document over defaults.
+
+    Accepts the reference ConfigMap schema::
+
+        actions: "allocate, reclaim"
+        tiers:
+        - plugins:
+          - name: nodeplacement
+            arguments: {gpu: spread, cpu: binpack}
+        queueDepthPerAction: {allocate: 100, reclaim: 10}
+        kValue: 0.5
+        schedulePeriod: 1.0
+
+    Absent fields keep the compiled defaults (ref
+    ``conf_util/scheduler_conf_util.go:80-90`` merge semantics).
+    """
+    if isinstance(doc, str):
+        doc = parse_document(doc)
+    doc = doc or {}
+    cfg = base or SchedulerConfig()
+    session = cfg.session
+    if "tiers" in doc:
+        session = _merge_tiers(doc["tiers"], session)
+    if "kValue" in doc:
+        session = dataclasses.replace(session,
+                                      k_value=float(doc["kValue"]))
+    depths: dict[str, Any] = doc.get("queueDepthPerAction") or {}
+    if depths:
+        def depth(action, current):
+            # explicit 0 means "attempt nothing", distinct from absent
+            # (keep default) — never collapse it to unlimited
+            return int(depths[action]) if action in depths else current
+
+        allocate = dataclasses.replace(
+            session.allocate,
+            queue_depth=depth("allocate", session.allocate.queue_depth))
+        victims = dataclasses.replace(
+            session.victims,
+            queue_depth=depth("reclaim", session.victims.queue_depth),
+            queue_depth_preempt=depth(
+                "preempt", session.victims.queue_depth_preempt))
+        session = dataclasses.replace(session, allocate=allocate,
+                                      victims=victims)
+    if "staleGangGracePeriodSeconds" in doc:
+        session = dataclasses.replace(
+            session, stale_grace_s=float(doc["staleGangGracePeriodSeconds"]))
+    out = dataclasses.replace(cfg, session=session)
+    if "actions" in doc:
+        out = dataclasses.replace(out,
+                                  actions=_parse_actions(doc["actions"]))
+    if "schedulePeriod" in doc:
+        out = dataclasses.replace(
+            out, schedule_period_s=float(doc["schedulePeriod"]))
+    return out
+
+
+def effective_config_doc(cfg: SchedulerConfig) -> dict:
+    """The fully-resolved configuration, for ``--print-config`` and the
+    operator's shard rendering."""
+    placement = cfg.session.allocate.placement
+    return {
+        "actions": ", ".join(cfg.actions),
+        "schedulePeriod": cfg.schedule_period_s,
+        "kValue": cfg.session.k_value,
+        "queueDepthPerAction": {
+            "allocate": cfg.session.allocate.queue_depth,
+            "reclaim": cfg.session.victims.queue_depth,
+            "preempt": (cfg.session.victims.queue_depth_preempt
+                        if cfg.session.victims.queue_depth_preempt
+                        is not None else cfg.session.victims.queue_depth),
+        },
+        "placement": {
+            "gpu": "binpack" if placement.binpack_accel else "spread",
+            "cpu": "binpack" if placement.binpack_cpu else "spread",
+            "device": "pack" if placement.device_pack else "spread",
+            "tiers": list(placement.tiers),
+        },
+        "staleGangGracePeriodSeconds": cfg.session.stale_grace_s,
+    }
+
+
+def dumps_effective(cfg: SchedulerConfig) -> str:
+    return json.dumps(effective_config_doc(cfg), indent=2)

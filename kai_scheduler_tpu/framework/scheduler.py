@@ -22,14 +22,46 @@ import functools
 import jax
 
 from ..apis import types as apis
-from ..ops.allocate import AllocationResult, allocate_jit, init_result
+from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
+                            init_result)
 from ..ops.stale import stale_gang_eviction
-from ..ops.victims import run_victim_action_jit
+from ..ops.victims import run_victim_action, run_victim_action_jit
 from ..runtime.cluster import Cluster
 from .session import Session, SessionConfig
 
 stale_eviction_jit = functools.partial(jax.jit, static_argnames=(
     "grace_s", "num_levels"))(stale_gang_eviction)
+
+#: pure (unjitted) action bodies — composed into ONE jitted program per
+#: cycle when every configured action is built in.  Separate per-action
+#: jit calls cost a dispatch round trip each (expensive through a
+#: tunneled TPU) and hide cross-action fusion from XLA.
+_PURE_ACTIONS = {
+    "allocate": lambda st, fs, res, nl, acfg, vcfg, grace: allocate(
+        st, fs, num_levels=nl, config=acfg, init=res),
+    "consolidation": lambda st, fs, res, nl, acfg, vcfg, grace:
+        run_victim_action(st, fs, res, num_levels=nl, mode="consolidate",
+                          config=vcfg),
+    "reclaim": lambda st, fs, res, nl, acfg, vcfg, grace:
+        run_victim_action(st, fs, res, num_levels=nl, mode="reclaim",
+                          config=vcfg),
+    "preempt": lambda st, fs, res, nl, acfg, vcfg, grace:
+        run_victim_action(st, fs, res, num_levels=nl, mode="preempt",
+                          config=vcfg),
+    "stalegangeviction": lambda st, fs, res, nl, acfg, vcfg, grace:
+        stale_gang_eviction(st, res, grace_s=grace, num_levels=nl),
+}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "actions", "num_levels", "acfg", "vcfg", "grace_s"))
+def _fused_pipeline(state, fair_share, *, actions, num_levels, acfg,
+                    vcfg, grace_s):
+    res = init_result(state)
+    for name in actions:
+        res = _PURE_ACTIONS[name](state, fair_share, res, num_levels,
+                                  acfg, vcfg, grace_s)
+    return res
 
 
 @dataclasses.dataclass
@@ -127,6 +159,12 @@ def _stale_action() -> Action:
     return run
 
 
+#: builders as shipped — the fused pipeline only engages when the
+#: configured actions still resolve to these (a re-registered override
+#: must run through the per-action path)
+_BUILTIN_BUILDERS = dict(_ACTION_REGISTRY)
+
+
 @dataclasses.dataclass
 class SchedulerConfig:
     """ref ``conf/scheduler_conf.go:49-62`` SchedulerConfiguration.
@@ -144,6 +182,12 @@ class SchedulerConfig:
     #: (placement strategy, k_value, queue depth) — ref SchedulingShard
     shard: apis.SchedulingShard | None = None
     node_pool_label_key: str = apis.NODE_POOL_LABEL_KEY
+    #: HA: a shared runtime.leader.Lease gating the cycle — only the
+    #: elected instance schedules (ref cmd/scheduler/app/server.go:60-63
+    #: leader election); None = single instance, always leads
+    leader_lease: object | None = None
+    #: this instance's election identity (pod name in the reference)
+    identity: str = "scheduler-0"
 
 
 def apply_shard_args(session: SessionConfig,
@@ -179,7 +223,7 @@ class Scheduler:
     """
 
     def __init__(self, config: SchedulerConfig | None = None,
-                 usage_lister=None):
+                 usage_lister=None, status_updater=None):
         self.config = config or SchedulerConfig()
         if self.config.shard is not None:
             self.config = dataclasses.replace(
@@ -187,6 +231,18 @@ class Scheduler:
                 session=apply_shard_args(self.config.session,
                                          self.config.shard))
         self.usage_lister = usage_lister
+        #: optional runtime.status_updater.AsyncStatusUpdater — fit
+        #: failure / condition writes go through its worker pool instead
+        #: of the cycle thread (ref cache/status_updater)
+        self.status_updater = status_updater
+        self._elector = None
+        if self.config.leader_lease is not None:
+            from ..runtime.leader import LeaderElector
+            self._elector = LeaderElector(self.config.leader_lease,
+                                          self.config.identity)
+        #: cycle-side view of fit-failure counts whose status writes may
+        #: still be queued (see _record_fit_status)
+        self._fit_shadow: dict[str, int] = {}
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
@@ -214,8 +270,15 @@ class Scheduler:
         return nodes, queues, groups, pods, topology
 
     def run_once(self, cluster: Cluster) -> CycleResult:
-        """One scheduling cycle: snapshot → actions → commit set."""
+        """One scheduling cycle: snapshot → actions → commit set.
+
+        Under leader election, a non-leader instance performs NO work
+        and commits nothing (the reference's followers block inside
+        ``leaderelection`` until elected)."""
         from . import metrics
+        if self._elector is not None and not self._elector.is_leader(
+                cluster.now):
+            return CycleResult()
         t0 = time.perf_counter()
         queue_usage = None
         if self.usage_lister is not None:
@@ -229,18 +292,36 @@ class Scheduler:
         metrics.open_session_latency.observe(value=open_s)
         result = CycleResult(tensors=init_result(session.state))
         result.open_seconds = open_s
-        for name, action in self._actions:
+        if all(name in _PURE_ACTIONS
+               and _ACTION_REGISTRY.get(name) is _BUILTIN_BUILDERS.get(name)
+               for name in self.config.actions):
+            # fast path: the whole action pipeline as one compiled program
+            cfg = session.config
             ta = time.perf_counter()
-            action(session, result)
-            result.action_seconds[name] = time.perf_counter() - ta
+            result.tensors = _fused_pipeline(
+                session.state, session.state.queues.fair_share,
+                actions=tuple(self.config.actions),
+                num_levels=cfg.num_levels, acfg=cfg.allocate,
+                vcfg=cfg.victims, grace_s=cfg.stale_grace_s)
+            result.action_seconds["pipeline"] = time.perf_counter() - ta
             metrics.action_latency.observe(
-                name, value=result.action_seconds[name])
+                "pipeline", value=result.action_seconds["pipeline"])
+        else:
+            for name, action in self._actions:
+                ta = time.perf_counter()
+                action(session, result)
+                result.action_seconds[name] = time.perf_counter() - ta
+                metrics.action_latency.observe(
+                    name, value=result.action_seconds[name])
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
+        # ONE batched device→host transfer feeds every host-side step.
         tc = time.perf_counter()
-        result.bind_requests = session.bind_requests_from(result.tensors)
+        host = session.gather_host(result.tensors)
+        result.bind_requests = session.bind_requests_from(
+            result.tensors, host=host)
         result.evictions = session.evictions_from(
-            result.tensors.victim, result.tensors.victim_move)
+            result.tensors.victim, result.tensors.victim_move, host=host)
         for br in result.bind_requests:
             cluster.create_bind_request(br)
         for ev in result.evictions:
@@ -255,29 +336,27 @@ class Scheduler:
                     result.move_bind_requests.append(rebind)
                     cluster.create_bind_request(rebind)
         result.commit_seconds = time.perf_counter() - tc
-        self._record_fit_status(cluster, session, result)
-        self._record_metrics(session, result)
+        self._record_fit_status(cluster, session, result, host)
+        self._record_metrics(session, result, host)
         result.session_seconds = time.perf_counter() - t0
         metrics.e2e_latency.observe(value=result.session_seconds)
         return result
 
-    def _record_metrics(self, session: Session,
-                        result: CycleResult) -> None:
+    def _record_metrics(self, session: Session, result: CycleResult,
+                        host: dict) -> None:
         """Per-cycle metric updates (ref metrics.go counters/gauges)."""
-        import numpy as np
-
         from . import metrics
         from ..apis.types import RESOURCE_NAMES
-        tensors = result.tensors
         metrics.podgroups_considered.inc(
-            by=float(np.asarray(tensors.attempted).sum()))
+            by=float(host["attempted"].sum()))
         metrics.podgroups_scheduled.inc(
-            "all", by=float(np.asarray(tensors.allocated).sum()))
-        # one bulk device→host transfer, then plain dict writes; skip
-        # unchanged gauge values to keep the cycle path O(changed)
-        fs = np.asarray(session.state.queues.fair_share)
-        alloc = np.asarray(tensors.queue_allocated)
-        usage = np.asarray(session.state.queues.usage)
+            "all", by=float(host["allocated"].sum()))
+        # arrays come from the cycle's single batched transfer; plain
+        # dict writes after, skipping unchanged gauge values to keep the
+        # cycle path O(changed)
+        fs = host["fair_share"]
+        alloc = host["queue_allocated"]
+        usage = host["queue_usage"]
         for gauge, table in ((metrics.queue_fair_share, fs),
                              (metrics.queue_allocated, alloc),
                              (metrics.queue_usage, usage)):
@@ -288,7 +367,7 @@ class Scheduler:
                         gauge.set(qname, rname, value=v)
 
     def _record_fit_status(self, cluster: Cluster, session: Session,
-                           result: CycleResult) -> None:
+                           result: CycleResult, host: dict) -> None:
         """Write fit failures back to PodGroup status — the
         status_updater's UnschedulableOnNodePool marking (ref
         ``cache/status_updater``, ``utils/pod_group_utils.go``): after
@@ -296,26 +375,58 @@ class Scheduler:
         marked unschedulable and the snapshot skips it until pod churn
         clears the condition (podgroup controller)."""
         import numpy as np
-        allocated = np.asarray(result.tensors.allocated)
-        explanations = session.unschedulable_explanations(result.tensors)
+        allocated = host["allocated"]
+        explanations = session.unschedulable_explanations(
+            result.tensors, host=host)
         names = session.index.gang_names
         # touch only gangs whose status actually changed: successes reset,
         # failures (the explanations keys) accumulate — O(changed), not
         # O(G) Python work on the cycle path
-        for gi in np.nonzero(allocated[:len(names)])[0]:
-            group = cluster.pod_groups.get(names[gi])
-            if group is not None and (group.fit_failures
-                                      or group.unschedulable):
+        # Writes go through the async worker pool when configured, so a
+        # slow status store never stalls the cycle (ref
+        # cache/status_updater/concurrency.go); inline otherwise.  The
+        # pool coalesces per key (latest wins), so every queued write is
+        # an ABSOLUTE status computed on the cycle thread — the shadow
+        # dict is the cycle's authoritative failure count while writes
+        # are in flight (the reference's in-flight pod-group records).
+        def write(key, fn):
+            if self.status_updater is None:
+                fn()
+            else:
+                self.status_updater.enqueue(key, fn)
+
+        shadow = self._fit_shadow
+
+        def reset(group):
+            def apply():
                 group.fit_failures = 0
                 group.unschedulable = False
                 group.unschedulable_reason = ""
+            return apply
+
+        def fail(group, failures, reason):
+            unsched = (group.scheduling_backoff >= 1
+                       and failures >= group.scheduling_backoff)
+
+            def apply():
+                group.fit_failures = failures
+                group.unschedulable_reason = reason
+                if unsched:
+                    group.unschedulable = True
+                    group.phase = apis.PodGroupPhase.UNSCHEDULABLE
+            return apply
+
+        for gi in np.nonzero(allocated[:len(names)])[0]:
+            group = cluster.pod_groups.get(names[gi])
+            if group is None:
+                continue
+            had = shadow.pop(names[gi], None)
+            if had is not None or group.fit_failures or group.unschedulable:
+                write(names[gi], reset(group))
         for name, reason in explanations.items():
             group = cluster.pod_groups.get(name)
             if group is None:
                 continue
-            group.fit_failures += 1
-            group.unschedulable_reason = reason
-            if (group.scheduling_backoff >= 1
-                    and group.fit_failures >= group.scheduling_backoff):
-                group.unschedulable = True
-                group.phase = apis.PodGroupPhase.UNSCHEDULABLE
+            failures = shadow.get(name, group.fit_failures) + 1
+            shadow[name] = failures
+            write(name, fail(group, failures, reason))

@@ -36,6 +36,52 @@ from ..state.cluster_state import ClusterState, SnapshotIndex, build_snapshot
 _set_fair_share_jit = functools.partial(
     jax.jit, static_argnames=("num_levels",))(drf.set_fair_share)
 
+#: The commit-path host bundle.  Two principles keep it small — it moves
+#: through a tunneled TPU link whose D2H costs ~70 ms + ~0.2 ms/KB:
+#: 1. snapshot-side arrays (task portions/requests, running-pod gangs,
+#:    usage) came FROM the host at build time — the SnapshotIndex keeps
+#:    the numpy originals, so only RESULT tensors transfer back;
+#: 2. results pack into ONE i16 array (indices are < 32k; bools ride 8
+#:    per lane; the small f32 queue tables bitcast to i16 pairs).
+
+
+def _bitpack(b: jax.Array) -> jax.Array:
+    """bool [K] → i16 [ceil(K/8)], bit k = element 8i+k (zero-padded —
+    snapshot padding is caller-settable, so K need not divide 8)."""
+    pad = (-b.shape[0]) % 8
+    if pad:
+        b = jnp.pad(b, (0, pad))
+    pb = b.reshape(-1, 8).astype(jnp.int16)
+    return jnp.sum(pb * (2 ** jnp.arange(8, dtype=jnp.int16)), axis=-1
+                   ).astype(jnp.int16)
+
+
+def _bitunpack(p: "np.ndarray", k: int) -> "np.ndarray":
+    return (((p.astype(np.int32)[:, None] >> np.arange(8)) & 1)
+            .astype(bool).reshape(-1)[:k])
+
+
+@functools.partial(jax.jit, static_argnames=("track_devices",))
+def _pack_commit(result: AllocationResult, state: ClusterState,
+                 *, track_devices: bool) -> jax.Array:
+    q = state.queues
+    parts = [
+        (result.placements + 1).ravel().astype(jnp.int16),
+        _bitpack(result.pipelined.ravel()),
+        _bitpack(result.allocated),
+        _bitpack(result.attempted),
+        result.fit_reason.astype(jnp.int16),
+        _bitpack(result.victim),
+        (result.victim_move + 1).astype(jnp.int16),
+        jax.lax.bitcast_convert_type(
+            result.queue_allocated, jnp.int16).ravel(),
+        jax.lax.bitcast_convert_type(q.fair_share, jnp.int16).ravel(),
+    ]
+    if track_devices:
+        parts.append(
+            (result.placement_device + 1).ravel().astype(jnp.int16))
+    return jnp.concatenate(parts)
+
 
 @dataclasses.dataclass
 class SessionConfig:
@@ -95,6 +141,7 @@ class Session:
                     extended=ext, dense_feasibility=dense),
                 victims=dataclasses.replace(
                     config.victims,
+                    chunk_reclaim=not index.has_reclaim_minruntime,
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
@@ -107,7 +154,52 @@ class Session:
 
     # -- commit path ------------------------------------------------------
 
-    def bind_requests_from(self, result: AllocationResult) -> list[apis.BindRequest]:
+    def gather_host(self, result: AllocationResult) -> dict:
+        """ONE compact device→host transfer of the cycle's results,
+        merged with the snapshot-side numpy tables the host never let go
+        of (see ``_pack_commit``)."""
+        g, q, r = self.state.gangs, self.state.queues, self.state.running
+        G, T, M, Q = g.g, g.t, r.m, q.q
+        R_ = self.state.nodes.free.shape[1]
+        assert self.state.nodes.n + 1 < 2**15, \
+            "i16 commit packing needs < 32k nodes"
+        devices = self.index.needs_device_table
+        flat = np.asarray(_pack_commit(result, self.state,
+                                       track_devices=devices))
+
+        def take(n):
+            nonlocal off
+            part = flat[off:off + n]
+            off += n
+            return part
+
+        def bits(k):
+            return (k + 7) // 8
+
+        off = 0
+        out = dict(self.index.host_tables)
+        out["placements"] = (take(G * T).astype(np.int32) - 1
+                             ).reshape(G, T)
+        out["pipelined"] = _bitunpack(take(bits(G * T)),
+                                      G * T).reshape(G, T)
+        out["allocated"] = _bitunpack(take(bits(G)), G)
+        out["attempted"] = _bitunpack(take(bits(G)), G)
+        out["fit_reason"] = take(G).astype(np.int32)
+        out["victim"] = _bitunpack(take(bits(M)), M)
+        out["victim_move"] = take(M).astype(np.int32) - 1
+        out["queue_allocated"] = np.frombuffer(
+            take(Q * R_ * 2).tobytes(), np.float32).reshape(Q, R_)
+        out["fair_share"] = np.frombuffer(
+            take(Q * R_ * 2).tobytes(), np.float32).reshape(Q, R_)
+        if devices:
+            out["placement_device"] = (take(G * T).astype(np.int32) - 1
+                                       ).reshape(G, T)
+        else:
+            out["placement_device"] = np.full((G, T), -1, np.int32)
+        return out
+
+    def bind_requests_from(self, result: AllocationResult,
+                           host: dict | None = None) -> list[apis.BindRequest]:
         """Placement tensors → BindRequest objects (``cache.Bind`` analogue).
 
         Only gangs with ``allocated=True`` produce requests — the kernels
@@ -117,10 +209,12 @@ class Session:
         binds on a later cycle once capacity actually frees
         (``stmt.Pipeline`` vs ``stmt.Allocate``).
         """
-        placements = np.asarray(result.placements)
-        devices = np.asarray(result.placement_device)
-        allocated = np.asarray(result.allocated)
-        pipelined = np.asarray(result.pipelined)
+        if host is None:
+            host = self.gather_host(result)
+        placements = host["placements"]
+        devices = host["placement_device"]
+        allocated = host["allocated"]
+        pipelined = host["pipelined"]
         # columnar translation: vectorized selection + per-column gathers,
         # then ONE tight zip constructing the objects — never per-row
         # numpy scalar indexing (that was ~0.5 s at 50k placements)
@@ -132,15 +226,14 @@ class Session:
         if not keep.all():
             gi, ti, names = gi[keep], ti[keep], names[keep]
         node_names = self.index.node_names_arr[placements[gi, ti]]
-        portion = np.asarray(self.state.gangs.task_portion)[gi, ti]
-        mem = np.asarray(self.state.gangs.task_accel_mem)[gi, ti]
+        portion = host["task_portion"][gi, ti]
+        mem = host["task_accel_mem"][gi, ti]
         is_frac = (portion > 0) | (mem > 0)
         count = np.where(
             is_frac, 0,
-            np.rint(np.asarray(self.state.gangs.task_req)[gi, ti, 0])
-            .astype(np.int64))
+            np.rint(host["task_req0"][gi, ti]).astype(np.int64))
         dev = devices[gi, ti]
-        dra = np.asarray(self.state.gangs.task_dra)[gi, ti]
+        dra = host["task_dra"][gi, ti]
         # DRA claim allocations: the binder resolves concrete devices; the
         # record carries the claimed count (ref ResourceClaimAllocations)
         frac_t = apis.ReceivedResourceType.FRACTION
@@ -164,32 +257,40 @@ class Session:
                 dev.tolist(), dra.tolist())
         ]
 
-    def evictions_from(self, victim_mask,
-                       victim_move=None) -> list[apis.Eviction]:
+    def evictions_from(self, victim_mask, victim_move=None,
+                       host: dict | None = None) -> list[apis.Eviction]:
         """Victim tensor [M] → Eviction objects (``cache.Evict`` analogue).
 
         ``victim_move`` ([M] node index, -1 = none) attaches the
         consolidation move target so the commit path can emit the
         pipelined rebind for the relocated pod.
         """
-        mask = np.asarray(victim_mask).copy()
+        if host is not None:
+            mask = host["victim"].copy()
+            moves_all = host["victim_move"]
+            gang_all = host["running_gang"]
+        else:
+            mask = np.asarray(victim_mask).copy()
+            moves_all = (None if victim_move is None
+                         else np.asarray(victim_move))
+            gang_all = np.asarray(self.state.running.gang)
         mask[len(self.index.running_pod_names):] = False
         mi = np.nonzero(mask)[0]
         names = self.index.running_pod_names_arr[mi]
         keep = names != ""
         if not keep.all():
             mi, names = mi[keep], names[keep]
-        gangs = np.asarray(self.state.running.gang)[mi]
+        gangs = gang_all[mi]
         ok_g = (gangs >= 0) & (gangs < len(self.index.gang_names))
         if len(self.index.gang_names):
             groups = np.where(ok_g, self.index.gang_names_arr[
                 np.clip(gangs, 0, len(self.index.gang_names) - 1)], "")
         else:
             groups = np.full(len(mi), "", object)
-        if victim_move is None:
+        if moves_all is None:
             targets = [None] * len(mi)
         else:
-            moves = np.asarray(victim_move)[mi]
+            moves = moves_all[mi]
             targets = [
                 self.index.node_names[m] if m >= 0 else None
                 for m in moves.tolist()]
@@ -206,16 +307,21 @@ class Session:
     }
 
     def unschedulable_explanations(
-            self, result: AllocationResult) -> dict[str, str]:
+            self, result: AllocationResult,
+            host: dict | None = None) -> dict[str, str]:
         """Per-gang fit-failure messages for gangs that ended the cycle
         unplaced — the UnschedulableExplanation surface."""
-        reasons = np.asarray(result.fit_reason)
-        allocated = np.asarray(result.allocated)
+        if host is not None:
+            reasons, allocated = host["fit_reason"], host["allocated"]
+        else:
+            reasons = np.asarray(result.fit_reason)
+            allocated = np.asarray(result.allocated)
         out: dict[str, str] = {}
-        for gi, name in enumerate(self.index.gang_names):
-            code = int(reasons[gi])
-            if code and not allocated[gi]:
-                out[name] = self.FIT_REASONS.get(code, f"code {code}")
+        # touch only failing gangs (O(failed), not O(G) int conversions)
+        ng = len(self.index.gang_names)
+        for gi in np.nonzero((reasons[:ng] != 0) & ~allocated[:ng])[0]:
+            out[self.index.gang_names[gi]] = self.FIT_REASONS.get(
+                int(reasons[gi]), f"code {int(reasons[gi])}")
         return out
 
     def move_bind_request(self, pod: apis.Pod,

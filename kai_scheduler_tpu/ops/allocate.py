@@ -82,6 +82,10 @@ class AllocationResult(struct.PyTreeNode):
     #: Releasing status mid-cycle (``framework/statement.go``).
     releasing_extra: jax.Array         # f32 [N, R]
     device_releasing_extra: jax.Array  # f32 [N, D]
+    #: extended (MIG) resources freed by this cycle's victims — credited
+    #: to the pipeline-fit pool so a preemptor needing a MIG slice held
+    #: only by victims can reclaim it (placements drawing on it pipeline)
+    extended_releasing_extra: jax.Array  # f32 [N, E]
     queue_allocated: jax.Array  # f32 [Q, R]
     queue_allocated_nonpreemptible: jax.Array  # f32 [Q, R]
     #: running pods evicted this cycle (victims of reclaim/preempt/
@@ -114,6 +118,7 @@ def init_result(state: ClusterState) -> AllocationResult:
         device_free=n.device_free,
         releasing_extra=jnp.zeros_like(n.free),
         device_releasing_extra=jnp.zeros_like(n.device_free),
+        extended_releasing_extra=jnp.zeros_like(n.extended_free),
         queue_allocated=q.allocated,
         queue_allocated_nonpreemptible=q.allocated_nonpreemptible,
         victim=jnp.zeros((state.running.m,), bool),
@@ -251,7 +256,8 @@ def _attempt_gang_in_domain(
         chain: jax.Array,              # bool [Q, Q] ancestor membership
         prior_nodes: jax.Array | None = None,  # i32 [T] prior placements
         quota: jax.Array | None = None,    # i32 [] max new placements
-        ext_free: jax.Array | None = None  # f32 [N, E] extended pool
+        ext_free: jax.Array | None = None,  # f32 [N, E] extended pool
+        extra_extended_releasing: jax.Array | None = None  # f32 [N, E]
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -290,6 +296,8 @@ def _attempt_gang_in_domain(
     task_ext = g.task_extended[gang_idx]     # [T, E]
     if ext_free is None:
         ext_free = n.extended_free
+    if extra_extended_releasing is None:
+        extra_extended_releasing = jnp.zeros_like(ext_free)
     queue = g.queue[gang_idx]
     nonpreempt = ~g.preemptible[gang_idx]
     # gang-internal anti-affinity: no two tasks in the same domain at
@@ -421,8 +429,8 @@ def _attempt_gang_in_domain(
             fit_idle = fit_idle & jnp.all(
                 ext_l + EPS >= te[None, :], axis=-1)
             fit_pipe = fit_pipe & jnp.all(
-                ext_l + n.extended_releasing + EPS >= te[None, :],
-                axis=-1)
+                ext_l + n.extended_releasing + extra_extended_releasing
+                + EPS >= te[None, :], axis=-1)
         allowed = domain_mask & ~forbidden
         # per-subgroup required level: once the subgroup's first task
         # lands, its whole domain at that level is locked for the rest.
@@ -618,7 +626,8 @@ def _attempt_gang_in_domain_uniform(
         lane: jax.Array, chain: jax.Array,
         prior_nodes: jax.Array | None = None,
         quota: jax.Array | None = None,
-        ext_free: jax.Array | None = None):
+        ext_free: jax.Array | None = None,
+        extra_extended_releasing: jax.Array | None = None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
     A gang whose T pending tasks are identical replicas (the dominant
@@ -829,7 +838,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   chain: jax.Array | None = None,
                   prior_nodes: jax.Array | None = None,
                   quota: jax.Array | None = None,
-                  ext_free: jax.Array | None = None):
+                  ext_free: jax.Array | None = None,
+                  extra_extended_releasing: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -867,7 +877,7 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
         state, gang_idx, free, device_free, q_alloc, q_alloc_np,
         num_levels, config, n.valid, pref_doms, has_pref,
         extra_releasing, extra_device_releasing, lane, chain,
-        prior_nodes, quota, ext_free)
+        prior_nodes, quota, ext_free, extra_extended_releasing)
 
 
 def allocate(
@@ -936,7 +946,9 @@ def allocate(
     def attempt_one(gi, lane, prior, quota, free, dev, qa, qan, ext):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
                              config, extra, extra_dev, lane, chain,
-                             prior_nodes=prior, quota=quota, ext_free=ext)
+                             prior_nodes=prior, quota=quota, ext_free=ext,
+                             extra_extended_releasing=init.
+                             extended_releasing_extra)
 
     def cond(carry):
         res, remaining, q_attempts, failed_sig, fuel = carry
@@ -1040,7 +1052,8 @@ def allocate(
             d_extbind = jnp.where(ok, extbind_b, 0.0)
             cum_ext = jnp.cumsum(d_ext, axis=0)
             cum_extbind = jnp.cumsum(d_extbind, axis=0)
-            ext_floor = -(n.extended_releasing[None]) - EPS
+            ext_floor = -(n.extended_releasing[None]
+                          + init.extended_releasing_extra[None]) - EPS
             accept = accept & jnp.all(
                 ext[None] - cum_ext >= ext_floor, axis=(1, 2))
             accept = accept & jnp.all(

@@ -99,7 +99,26 @@ def select_next_gang(
     """Index of the next gang to attempt (i32 scalar; any index if none
     remain — callers must also branch on ``jnp.any(remaining)``).
 
-    Equivalent to one ``PopNextJob`` from the two-level heap.
+    Equivalent to one ``PopNextJob`` from the two-level heap — computed
+    as a cascade of masked min-reductions instead of a full lexsort: the
+    pop only needs the MINIMUM in lexicographic order, and eight [G]
+    reductions are far cheaper than a [G] multi-key sort inside a
+    per-step ``while_loop`` body (same result, including the smallest-
+    index tie-break).
     """
-    return job_order_perm(
-        gangs, queues, queue_allocated, fair_share, total, remaining)[0]
+    over_fs, over_quota, neg_prio, dom_share = queue_order_keys(
+        queues, queue_allocated, fair_share, total)
+    qi = gangs.queue
+    below_min = gangs.running_count < gangs.min_member
+    keys = (
+        (~remaining).astype(jnp.float32),
+        over_fs[qi], over_quota[qi], neg_prio[qi], dom_share[qi],
+        (~below_min).astype(jnp.float32),
+        -gangs.priority.astype(jnp.float32),
+        gangs.creation_order.astype(jnp.float32),
+    )
+    best = jnp.ones_like(remaining)
+    for k in keys:
+        m = jnp.min(jnp.where(best, k, jnp.inf))
+        best = best & (k <= m)
+    return jnp.argmax(best)

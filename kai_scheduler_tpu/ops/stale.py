@@ -49,7 +49,7 @@ def stale_gang_eviction(
                & ~result.victim)
 
     chain = _chain_membership(state.queues.parent, num_levels)
-    freed_nodes, freed_dev, freed_q, freed_q_np = freed_by_mask(
+    freed_nodes, freed_dev, freed_q, freed_q_np, freed_ext = freed_by_mask(
         state, victims, chain)
     # the evicted pods' capacity is releasing (they have not terminated) —
     # tasks placed on it must pipeline, so it joins releasing_extra
@@ -57,6 +57,8 @@ def stale_gang_eviction(
         victim=result.victim | victims,
         releasing_extra=result.releasing_extra + freed_nodes,
         device_releasing_extra=result.device_releasing_extra + freed_dev,
+        extended_releasing_extra=(result.extended_releasing_extra
+                                  + freed_ext),
         queue_allocated=jnp.maximum(result.queue_allocated - freed_q, 0.0),
         queue_allocated_nonpreemptible=jnp.maximum(
             result.queue_allocated_nonpreemptible - freed_q_np, 0.0),

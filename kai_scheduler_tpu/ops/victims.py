@@ -76,20 +76,34 @@ class VictimConfig:
     placement: AllocateConfig = AllocateConfig(dynamic_order=False)
     #: reclaimerSaturationMultiplier (``plugins/proportion/proportion.go:67-95``)
     saturation_multiplier: float = 1.0
-    #: max preemptor gangs attempted per cycle (QueueDepthPerAction)
+    #: max preemptor gangs attempted per QUEUE (QueueDepthPerAction) for
+    #: reclaim/consolidation; None = unlimited
     queue_depth: int | None = None
+    #: preempt's own depth; None = inherit ``queue_depth``
+    queue_depth_preempt: int | None = None
     #: cap on eviction units per consolidation scenario — ref
     #: ``MaxNumberConsolidationPreemptees`` (consolidation.go)
     max_consolidation_preemptees: int = 64
+    #: preemptor gangs attempted per wavefront chunk (reclaim/preempt).
+    #: Lanes consume DISJOINT consecutive ranges of the shared
+    #: eviction-unit order, so victim assignment cannot conflict; an
+    #: allocate-style accept-prefix re-verifies composed capacity and
+    #: queue gates.  1 = fully sequential (reference-exact order).
+    batch_size: int = 16
+    #: reclaim may use the chunked path — False when the snapshot
+    #: carries per-(victim,reclaimer) reclaim-minruntime protection,
+    #: whose lane-dependent tables need the sequential path.  The
+    #: Session derives this from the snapshot.
+    chunk_reclaim: bool = False
 
 
 def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
     """Resources released by evicting the masked running pods.
 
     Returns (freed_nodes [N, R], freed_devices [N, D], freed_queues
-    [Q, R], freed_queues_nonpreemptible [Q, R]) with the queue tensors
-    rolled up the hierarchy via ``chain`` — shared by the victim solver
-    and the stalegangeviction action.
+    [Q, R], freed_queues_nonpreemptible [Q, R], freed_extended [N, E])
+    with the queue tensors rolled up the hierarchy via ``chain`` — shared
+    by the victim solver and the stalegangeviction action.
     """
     r = state.running
     n, q = state.nodes, state.queues
@@ -121,7 +135,123 @@ def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
     chain_f = chain.astype(leaf.dtype)
     freed_q = jnp.einsum("qa,qr->ar", chain_f, leaf)
     freed_q_np = jnp.einsum("qa,qr->ar", chain_f, leaf_np)
-    return freed_nodes, freed_dev, freed_q, freed_q_np
+    # extended (MIG) scalars held by the victims return to their node's
+    # pool — the credit-back that lets a preemptor reclaim a MIG slice
+    freed_ext = jax.ops.segment_sum(
+        jnp.where(mask[:, None], r.extended, 0.0),
+        jnp.where(mask, jnp.maximum(r.node, 0), n.n),
+        num_segments=n.n + 1)[:n.n]
+    return freed_nodes, freed_dev, freed_q, freed_q_np, freed_ext
+
+
+def _freed_by_prefixes(state: ClusterState, cand: jax.Array,
+                       unit_rank: jax.Array, k_b: jax.Array,
+                       chain: jax.Array):
+    """Per-lane prefix freed tensors for DISJOINT lane ranges.
+
+    Lane ``b``'s scenario frees units ``<= k_b`` (``k_b`` nondecreasing),
+    so each pod belongs to exactly one first lane
+    (``searchsorted(k_b, unit)``) and every per-lane prefix is a cumsum
+    of per-lane range sums — ONE segment_sum over the pod axis instead
+    of a vmapped scatter per lane (vmapped scatters dominate the chunk
+    cost on TPU).  Returns (freed_nodes [B,N,R], freed_dev [B,N,D],
+    freed_queues [B,Q,R], freed_ext [B,N,E]).
+    """
+    r, n, q = state.running, state.nodes, state.queues
+    B = k_b.shape[0]
+    N, D, Q = n.n, n.d, q.q
+    lane = jnp.searchsorted(k_b, unit_rank)                    # [M] 0..B
+    live = cand & (lane < B)
+    lane_s = jnp.where(live, lane, B)
+    req_m = jnp.where(live[:, None], r.req, 0.0)
+    node_s = jnp.where(live, jnp.maximum(r.node, 0), N)
+    seg_n = lane_s * (N + 1) + node_s
+    per_n = jax.ops.segment_sum(
+        req_m, seg_n, num_segments=(B + 1) * (N + 1))
+    freed_n = jnp.cumsum(
+        per_n.reshape(B + 1, N + 1, -1)[:B, :N], axis=0)       # [B, N, R]
+    frac = live & (r.device >= 0)
+    seg_d = (jnp.where(frac, lane_s, B) * (N * D + 1)
+             + jnp.where(frac, node_s * D + jnp.maximum(r.device, 0),
+                         N * D))
+    per_d = jax.ops.segment_sum(
+        jnp.where(frac, r.accel_held, 0.0), seg_d,
+        num_segments=(B + 1) * (N * D + 1))
+    per_d = per_d.reshape(B + 1, N * D + 1)[:B, :N * D].reshape(B, N, D)
+    bits = ((r.devices_mask[:, None] >> jnp.arange(D)[None, :]) & 1)
+    whole = bits.astype(req_m.dtype) * (live & (r.device < 0))[:, None]
+    per_w = jax.ops.segment_sum(
+        whole, seg_n, num_segments=(B + 1) * (N + 1))
+    freed_d = jnp.cumsum(
+        per_d + per_w.reshape(B + 1, N + 1, D)[:B, :N], axis=0)
+    seg_q = lane_s * (Q + 1) + jnp.where(live, jnp.maximum(r.queue, 0), Q)
+    per_q = jax.ops.segment_sum(
+        req_m, seg_q, num_segments=(B + 1) * (Q + 1))
+    leaf_cum = jnp.cumsum(
+        per_q.reshape(B + 1, Q + 1, -1)[:B, :Q], axis=0)       # [B, Q, R]
+    freed_q = jnp.einsum("qa,bqr->bar", chain.astype(req_m.dtype),
+                         leaf_cum)
+    per_e = jax.ops.segment_sum(
+        jnp.where(live[:, None], r.extended, 0.0), seg_n,
+        num_segments=(B + 1) * (N + 1))
+    freed_e = jnp.cumsum(
+        per_e.reshape(B + 1, N + 1, -1)[:B, :N], axis=0)
+    return freed_n, freed_d, freed_q, freed_e
+
+
+def _pod_order_static(state: ClusterState):
+    """Within-gang pod order (newest first) — preemptor-independent, so
+    it is computed ONCE per action instead of a [M] lexsort per
+    preemptor.  Returns (perm0 [M], gang_perm [M])."""
+    r = state.running
+    G = state.gangs.g
+    gang_all = jnp.where(r.valid & (r.gang >= 0), r.gang, G)
+    perm0 = jnp.lexsort((r.runtime_s, gang_all))
+    return perm0, gang_all[perm0]
+
+
+def victim_statics(state: ClusterState):
+    """Preemptor-independent victim-search inputs, hoisted out of the
+    per-preemptor solve (the per-step cost is what bounds cycle latency):
+
+    - ``base0`` [M]: the candidate filter minus the per-preemptor parts
+    - ``gang_runtime`` [G]: max pod runtime per gang (minruntime input);
+      -1 when the gang never started (nil LastStartTimestamp => NOT
+      protected, ref minruntime.go)
+    - ``pod_order``: within-gang newest-first order (see
+      :func:`_pod_order_static`)
+    """
+    r = state.running
+    G = state.gangs.g
+    base0 = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
+             & (r.gang >= 0))
+    gang_runtime = jax.ops.segment_max(
+        jnp.where(r.valid & (r.gang >= 0), r.runtime_s, -1.0),
+        jnp.where(r.gang >= 0, r.gang, G), num_segments=G + 1)[:G]
+    return base0, gang_runtime, _pod_order_static(state)
+
+
+def frozen_job_rank(state: ClusterState, queue_allocated: jax.Array,
+                    fair_share: jax.Array) -> jax.Array:
+    """Victim-JOB ordering, frozen at action start — the reference
+    regenerates the victim queue order from live shares per preemptor;
+    freezing it trades that re-sort for one [G] lexsort per ACTION
+    (bounded drift: within one action, shares only move monotonically).
+    Most-saturated queue first, lowest priority first, newest first.
+    Gangs that turn out to expose no units occupy rank slots but
+    contribute nothing to the unit cumsum, so unit ranks stay dense."""
+    g = state.gangs
+    G = g.g
+    sat = jnp.max(
+        queue_allocated / jnp.maximum(fair_share, EPS), axis=-1)  # [Q]
+    gq = jnp.maximum(g.queue, 0)
+    rank_gang = jnp.lexsort((
+        -g.creation_order.astype(jnp.float32),
+        g.priority.astype(jnp.float32),
+        -sat[gq],
+    ))
+    return jnp.zeros((G,), jnp.int32).at[rank_gang].set(
+        jnp.arange(G, dtype=jnp.int32))
 
 
 def victim_candidates(
@@ -130,6 +260,7 @@ def victim_candidates(
     *,
     mode: str,
     already_victim: jax.Array,   # bool [M]
+    statics=None,                # victim_statics(state) output
 ) -> jax.Array:
     """bool [M] — pods eligible as victims for this preemptor.
 
@@ -146,9 +277,10 @@ def victim_candidates(
     r = state.running
     g = state.gangs
     q = state.queues
-    G = g.g
-    base = (r.valid & ~r.releasing & (r.node >= 0) & r.preemptible
-            & (r.gang >= 0) & ~already_victim)
+    if statics is None:
+        statics = victim_statics(state)
+    base0, gang_runtime, _ = statics
+    base = base0 & ~already_victim
     my_queue = g.queue[gang_idx]
     # gang-level minruntime protection (hierarchy/LCA-resolved at
     # snapshot build — ref plugins/minruntime/resolver.go).  A protected
@@ -156,9 +288,6 @@ def victim_candidates(
     # off-limits (ref reclaimFilterFn returning true for elastic jobs +
     # the scenario validator) — enforced by the unit ranking, which gives
     # protected gangs no whole-gang unit.
-    gang_runtime = jax.ops.segment_max(
-        jnp.where(r.valid & (r.gang >= 0), r.runtime_s, -1.0),
-        jnp.where(r.gang >= 0, r.gang, G), num_segments=G + 1)[:G]
     gq = jnp.maximum(g.queue, 0)
     if mode == "reclaim":
         mrt_g = q.reclaim_min_runtime_eff[gq, my_queue]          # [G]
@@ -180,14 +309,16 @@ def _rank_eviction_units(
     fair_share: jax.Array,       # f32 [Q, R]
     already_victim: jax.Array,   # bool [M]  victims accumulated this cycle
     protected: jax.Array | None = None,  # bool [G]  minruntime-protected
+    pod_order=None,              # (perm0, gang_perm) from _pod_order_static
+    job_rank: jax.Array | None = None,   # frozen_job_rank output
 ):
     """Assign every candidate pod a global eviction-unit rank.
 
-    Victim *jobs* are ordered by a lexsort over gang keys — the reference
-    generates victims queue-by-queue in reversed queue order (most
-    over-fair-share first) and job-by-job in reversed job order (lowest
-    priority, newest first).  Within a gang, pods are ordered by reverse
-    task order (shortest-running ≈ newest first); each of the first
+    Victim *jobs* follow ``frozen_job_rank`` — the reference generates
+    victims queue-by-queue in reversed queue order (most over-fair-share
+    first) and job-by-job in reversed job order (lowest priority, newest
+    first).  Within a gang, pods are ordered by reverse task order
+    (shortest-running ≈ newest first); each of the first
     ``allocated - minMember`` pods is its own unit (elastic shrink), the
     remaining ``minMember`` pods form one final unit
     (``eviction_info.go GetTasksToEvict``).
@@ -203,28 +334,20 @@ def _rank_eviction_units(
         cand.astype(jnp.int32), gang_of_pod, num_segments=G + 1)[:G]
     victim_gang = pods_per_gang > 0
 
-    # ---- job-level ordering ---------------------------------------------
-    sat = jnp.max(
-        queue_allocated / jnp.maximum(fair_share, EPS), axis=-1)  # [Q]
-    gq = jnp.maximum(g.queue, 0)
-    # lexsort: last key most significant — non-victim gangs last, most
-    # saturated queue first, lowest priority first, newest first.
-    rank_gang = jnp.lexsort((
-        -g.creation_order.astype(jnp.float32),
-        g.priority.astype(jnp.float32),
-        -sat[gq],
-        (~victim_gang).astype(jnp.float32),
-    ))                                                          # [G] gang @ rank
-    job_rank = jnp.zeros((G,), jnp.int32).at[rank_gang].set(
-        jnp.arange(G, dtype=jnp.int32))                         # [G]
+    if job_rank is None:
+        job_rank = frozen_job_rank(state, queue_allocated, fair_share)
 
     # ---- pod order within gang (reverse task order: newest first) -------
-    perm = jnp.lexsort((r.runtime_s, gang_of_pod))              # [M]
-    pos = jnp.zeros((M,), jnp.int32).at[perm].set(
-        jnp.arange(M, dtype=jnp.int32))
-    first_pos = jax.ops.segment_min(
-        jnp.where(cand, pos, BIG), gang_of_pod, num_segments=G + 1)[:G]
-    seq = pos - first_pos[jnp.minimum(gang_of_pod, G - 1)]      # [M]
+    # seq = rank among this gang's CANDIDATES in the hoisted static order:
+    # gather→cumsum→scatter instead of a per-preemptor [M] lexsort
+    if pod_order is None:
+        pod_order = _pod_order_static(state)
+    perm0, gang_perm = pod_order
+    cand_p = cand[perm0].astype(jnp.int32)
+    excl = jnp.cumsum(cand_p) - cand_p                          # [M]
+    base = jax.ops.segment_min(excl, gang_perm, num_segments=G + 1)[:G]
+    seq_p = excl - base[jnp.minimum(gang_perm, G - 1)]
+    seq = jnp.zeros((M,), jnp.int32).at[perm0].set(seq_p)       # [M]
 
     # ---- unit ids --------------------------------------------------------
     # Surplus is sized from the gang's *effective* active pod count:
@@ -249,7 +372,8 @@ def _rank_eviction_units(
         whole_unit = whole_unit & ~protected
     units_per_gang = jnp.where(
         victim_gang, surplus + whole_unit, 0)                   # [G]
-    units_by_rank = units_per_gang[rank_gang]                   # [G]
+    units_by_rank = jnp.zeros((G,), units_per_gang.dtype).at[
+        job_rank].set(units_per_gang)                           # [G]
     offsets = jnp.cumsum(units_by_rank) - units_by_rank         # [G] excl
     gsafe = jnp.minimum(gang_of_pod, G - 1)
     unit_in_gang = jnp.minimum(seq, surplus[gsafe])
@@ -285,11 +409,14 @@ def solve_for_preemptor(
     num_levels: int,
     mode: str,                   # "reclaim" | "preempt" | "consolidate"
     config: VictimConfig,
+    statics=None,                # hoisted victim_statics output
+    job_rank: jax.Array | None = None,   # hoisted frozen_job_rank
 ):
     """One preemptor's scenario search — returns updated commit-set fields.
 
-    (success, victim_mask [M], task placements [T], pipelined [T],
-    moves [M], free', qa', qan')
+    (success, victim_mask [M], task placements [T], devices [T],
+    pipelined [T], moves [M], free', dev', extra', extra_dev', qa',
+    qan', ext', ext_extra')
     """
     reclaim = mode == "reclaim"
     consolidate = mode == "consolidate"
@@ -322,8 +449,11 @@ def solve_for_preemptor(
     else:
         gate = nonpreempt_quota_ok
 
+    if statics is None:
+        statics = victim_statics(state)
     cand, protected = victim_candidates(
-        state, gang_idx, mode=mode, already_victim=result.victim)
+        state, gang_idx, mode=mode, already_victim=result.victim,
+        statics=statics)
     gate &= jnp.any(cand)
 
     # moved (consolidated) victims stay active gang members — they restart
@@ -331,7 +461,8 @@ def solve_for_preemptor(
     # effective active count for unit sizing
     removed_victims = result.victim & (result.victim_move < 0)
     unit_rank, num_units = _rank_eviction_units(
-        state, cand, qa, fair_share, removed_victims, protected)
+        state, cand, qa, fair_share, removed_victims, protected,
+        statics[2], job_rank)
     if consolidate:
         num_units = jnp.minimum(num_units,
                                 config.max_consolidation_preemptees)
@@ -339,134 +470,170 @@ def solve_for_preemptor(
         q.parent, queue, num_levels, qa, q.quota, total_req)
     quota_eff = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
     m_req = jnp.where(cand[:, None], r.req, 0.0)               # [M, R]
-    leveled = jax.vmap(
-        lambda vq: _leveled_queue(chain, q.depth, vq, queue))(
-            jnp.maximum(r.queue, 0))                           # [M]
+    M = r.m
+    urank_safe = jnp.minimum(unit_rank, M)
 
-    # idle_gpus-style prefilter: fast-forward to the first scenario whose
-    # aggregate free + freed covers the preemptor's total request.
-    unit_freed = jax.ops.segment_sum(
-        m_req, jnp.minimum(unit_rank, r.m), num_segments=r.m + 1)[:r.m]
-    cum_freed = jnp.cumsum(unit_freed, axis=0)                 # [M, R]
+    # ---- per-unit tables, vectorized over ALL unit ranks at once --------
+    unit_req = jax.ops.segment_sum(
+        m_req, urank_safe, num_segments=M + 1)[:M]             # [U, R]
+    cum_freed = jnp.cumsum(unit_req, axis=0)                   # [U, R]
+    # idle_gpus-style prefilter: the first scenario whose aggregate
+    # free + freed covers the preemptor's request lower-bounds the search
     cluster_free = jnp.sum(
         jnp.where(n.valid[:, None], free + n.releasing + extra, 0.0),
         axis=0)
     enough = jnp.all(cluster_free[None, :] + cum_freed + EPS
-                     >= total_req[None, :], axis=-1)           # [M]
-    gate_prefilter = jnp.any(enough)  # no scenario can ever fit => skip all
+                     >= total_req[None, :], axis=-1)           # [U] monotone
+    gate_prefilter = jnp.any(enough)
+
+    # FitsReclaimStrategy per unit (the reference's running
+    # remainingResourcesMap check), vectorized: unit u passes iff its
+    # leveled queue's remaining share BEFORE u (qa minus the freed
+    # prefix inside that queue's subtree) is still above fair share /
+    # deserved quota.  Scenario validity needs every unit of the prefix
+    # to pass, so the first failing unit truncates the search range.
+    if reclaim:
+        unit_leaf = jax.ops.segment_max(
+            jnp.where(cand, r.queue, -1), urank_safe,
+            num_segments=M + 1)[:M]                            # [U]
+        leaf_safe = jnp.maximum(unit_leaf, 0)
+        lq_u = jax.vmap(
+            lambda vq: _leveled_queue(chain, q.depth, vq, queue))(
+                leaf_safe)                                     # [U]
+        contrib = chain[leaf_safe] & (unit_leaf >= 0)[:, None]  # [U, Q]
+        inc = contrib[:, :, None] * unit_req[:, None, :]       # [U, Q, R]
+        csum_excl = jnp.cumsum(inc, axis=0) - inc
+        lq_safe = jnp.maximum(lq_u, 0)
+        freed_excl = csum_excl[jnp.arange(M), lq_safe]         # [U, R]
+        remaining_u = qa[lq_safe] - freed_excl
+        over_fs = jnp.any(remaining_u > fair_share[lq_safe] + EPS, -1)
+        over_q = jnp.any(remaining_u > quota_eff[lq_safe] + EPS, -1)
+        pass_u = (lq_u < 0) | over_fs | (reclaimer_under_quota & over_q)
+    else:
+        pass_u = jnp.ones((M,), bool)
+    bad = (jnp.arange(M) < num_units) & ~pass_u
+    first_bad = jnp.where(jnp.any(bad), jnp.argmax(bad), num_units)
+    hi = jnp.minimum(num_units, first_bad) - 1   # largest admissible k
+    lo = jnp.argmax(enough)                      # smallest k that can fit
+    can_search = gate & gate_prefilter & (hi >= lo)
 
     T = g.t
     alloc_cfg = config.placement
+    no_moves = jnp.full((M,), -1, jnp.int32)
+    ext_extra = result.extended_releasing_extra
 
-    def freed_tensors(mask):
-        """(freed_nodes [N, R], freed_devices [N, D], freed_queues [Q, R])."""
-        freed_nodes, freed_dev, freed_q, _ = freed_by_mask(state, mask, chain)
-        return freed_nodes, freed_dev, freed_q
-
-    def unit_strategy_ok(k, freed_q_excl):
-        """FitsReclaimStrategy for the unit being added at rank ``k``,
-        against remaining shares *before* this step."""
-        if not reclaim:
-            return jnp.asarray(True)
-        in_unit = cand & (unit_rank == k)
-        # leveled queue of this unit's pods (all share one gang => one queue)
-        lq = jnp.max(jnp.where(in_unit, leveled, -1))
-        lq_safe = jnp.maximum(lq, 0)
-        remaining = qa[lq_safe] - freed_q_excl[lq_safe]        # [R]
-        over_fs = jnp.any(remaining > fair_share[lq_safe] + EPS)
-        over_quota = jnp.any(remaining > quota_eff[lq_safe] + EPS)
-        return (lq < 0) | over_fs | (reclaimer_under_quota & over_quota)
-
-    no_moves = jnp.full((r.m,), -1, jnp.int32)
-
-    def cond(carry):
-        k, done, prefix_ok, _ = carry
-        return (~done) & prefix_ok & (k < num_units)
-
-    def body(carry):
-        k, done, prefix_ok, best = carry
-        if reclaim:
-            mask_excl = cand & (unit_rank < k)
-            _, _, freed_q_excl = freed_tensors(mask_excl)
-            prefix_ok = prefix_ok & unit_strategy_ok(k, freed_q_excl)
-
-        def run(_):
-            mask_k = cand & (unit_rank <= k)
-            freed_nodes, freed_dev, freed_queues = freed_tensors(mask_k)
-            # victim capacity is *releasing* until the pods terminate:
-            # the preemptor's tasks that land on it pipeline, tasks that
-            # fit genuinely idle capacity bind now (stmt.Allocate vs
-            # stmt.Pipeline).
-            extra_eff = extra + freed_nodes
-            extra_dev_eff = extra_dev + freed_dev
-            # consolidation victims are moved, not removed — their queue
-            # allocation stays (allPodsReallocated validator below)
-            qa_eff = qa if consolidate else qa - freed_queues
-            # victim search attempts gangs one at a time, so the
-            # wavefront bind-claim tensors are not needed; the preemptor's
-            # extended (MIG/DRA) debit IS kept so later gangs see the
-            # shrunken pool (victims' extended resources are
-            # conservatively NOT credited back)
-            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
-             _, _, ext2, _) = \
-                _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
-                              num_levels, alloc_cfg, extra_eff,
-                              extra_dev_eff, chain=chain,
-                              ext_free=result.extended_free)
-            if consolidate:
-                free3, dev3, moves, all_ok = _replace_victims(
-                    state, mask_k, free2, dev2, n.releasing + extra_eff,
-                    state.nodes.device_releasing + extra_dev_eff)
-                return (free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t,
-                        moves, extra_eff, extra_dev_eff, ext2,
-                        success & all_ok)
-            return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t,
-                    no_moves, extra_eff, extra_dev_eff, ext2, success)
-
-        def skip(_):
-            return (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
-                    jnp.full((T,), -1, jnp.int32),
-                    jnp.zeros((T,), bool), no_moves, extra, extra_dev,
-                    result.extended_free, jnp.asarray(False))
-
-        (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves, extra2,
-         extra_dev2, ext2, success) = \
-            lax.cond(prefix_ok & enough[jnp.minimum(k, r.m - 1)],
-                     run, skip, None)
-        best = jax.tree.map(
-            lambda new, old: jnp.where(success, new, old),
-            (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-             extra2, extra_dev2, ext2, k),
-            best)
-        return k + 1, success, prefix_ok, best
+    def attempt(k):
+        """Simulate scenario prefix ``k``: evict, credit, re-place."""
+        mask_k = cand & (unit_rank <= k)
+        freed_nodes, freed_dev, freed_q, _, freed_ext = freed_by_mask(
+            state, mask_k, chain)
+        # victim capacity is *releasing* until the pods terminate: the
+        # preemptor's tasks that land on it pipeline, tasks that fit
+        # genuinely idle capacity bind now (stmt.Allocate vs Pipeline)
+        extra_eff = extra + freed_nodes
+        extra_dev_eff = extra_dev + freed_dev
+        ext_extra_eff = ext_extra + freed_ext
+        # consolidation victims are moved, not removed — their queue
+        # allocation stays (allPodsReallocated validator below)
+        qa_eff = qa if consolidate else qa - freed_q
+        (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
+         _, _, ext2, _) = \
+            _attempt_gang(state, gang_idx, free, dev, qa_eff, qan,
+                          num_levels, alloc_cfg, extra_eff,
+                          extra_dev_eff, chain=chain,
+                          ext_free=result.extended_free,
+                          extra_extended_releasing=ext_extra_eff)
+        if consolidate:
+            free3, dev3, moves, all_ok = _replace_victims(
+                state, mask_k, free2, dev2, n.releasing + extra_eff,
+                state.nodes.device_releasing + extra_dev_eff,
+                max_pods=max(512, config.max_consolidation_preemptees * T))
+            return success & all_ok, (
+                free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
+                extra_eff, extra_dev_eff, ext2, ext_extra_eff, k)
+        return success, (
+            free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, no_moves,
+            extra_eff, extra_dev_eff, ext2, ext_extra_eff, k)
 
     empty = (free, dev, qa, qan, jnp.full((T,), -1, jnp.int32),
              jnp.full((T,), -1, jnp.int32),
              jnp.zeros((T,), bool), no_moves, extra, extra_dev,
-             result.extended_free, jnp.asarray(0, jnp.int32))
+             result.extended_free, ext_extra, jnp.asarray(0, jnp.int32))
 
-    def search(_):
-        _, done, _, best = lax.while_loop(
-            cond, body,
-            (jnp.asarray(0, jnp.int32), jnp.asarray(False),
-             jnp.asarray(True), empty))
-        return done, best
+    # ---- search over the unit prefix ------------------------------------
+    # Freed capacity grows monotonically with k, so placement success is
+    # monotone for capacity-style constraints (reclaim/preempt); the
+    # search probes the capacity lower bound first (tight in the common
+    # case — ONE attempt), then ``hi`` (failing preemptors cost one more)
+    # and bisects to the smallest succeeding prefix — the minimal victim
+    # set the reference's one-unit-at-a-time walk finds, in O(log U)
+    # placement attempts.  Consolidation's allPodsReallocated validator
+    # is NOT monotone (extra victims must also re-place), so it keeps
+    # the reference's linear first-success walk — num_units is already
+    # capped by max_consolidation_preemptees.
+    if consolidate:
+        def search(_):
+            def cond_l(c):
+                k, done, _ = c
+                return (~done) & (k <= hi)
 
-    def no_search(_):
-        return jnp.asarray(False), empty
+            def body_l(c):
+                k, done, best = c
+                s, tm = attempt(k)
+                best = jax.tree.map(
+                    lambda a, b: jnp.where(s, a, b), tm, best)
+                return k + 1, s, best
+
+            _, done, best = lax.while_loop(
+                cond_l, body_l,
+                (lo, jnp.asarray(False), empty))
+            return done, best
+    else:
+        def search(_):
+            s_lo, t_lo = attempt(lo)
+
+            def refine(_):
+                s_hi, t_hi = attempt(hi)
+
+                def bcond(c):
+                    lo_c, hi_c, _ = c
+                    return lo_c + 1 < hi_c
+
+                def bbody(c):
+                    # invariant: lo_c fails, hi_c succeeds
+                    lo_c, hi_c, best = c
+                    mid = (lo_c + hi_c) // 2
+                    s, tm = attempt(mid)
+                    best = jax.tree.map(
+                        lambda a, b: jnp.where(s, a, b), tm, best)
+                    return (jnp.where(s, lo_c, mid),
+                            jnp.where(s, mid, hi_c), best)
+
+                def run_bisect(_):
+                    _, _, best = lax.while_loop(bcond, bbody,
+                                                (lo, hi, t_hi))
+                    return jnp.asarray(True), best
+
+                return lax.cond(s_hi, run_bisect,
+                                lambda _: (jnp.asarray(False), empty),
+                                None)
+
+            return lax.cond(s_lo, lambda _: (jnp.asarray(True), t_lo),
+                            refine, None)
 
     success, (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-              extra2, extra_dev2, ext2, k_win) = lax.cond(
-                  gate & gate_prefilter, search, no_search, None)
+              extra2, extra_dev2, ext2, ext_extra2, k_win) = lax.cond(
+                  can_search, search,
+                  lambda _: (jnp.asarray(False), empty), None)
 
     victim_mask = cand & (unit_rank <= k_win) & success
     return (success, victim_mask, nodes_t, dev_t, pipe_t, moves,
-            free2, dev2, extra2, extra_dev2, qa2, qan2, ext2)
+            free2, dev2, extra2, extra_dev2, qa2, qan2, ext2, ext_extra2)
 
 
 def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
                      device_free: jax.Array, releasing: jax.Array,
-                     device_releasing: jax.Array):
+                     device_releasing: jax.Array, max_pods: int = 512):
     """Greedy re-placement of evicted consolidation victims — the
     ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
     scenario is valid only if *every* victim fits somewhere on the
@@ -475,15 +642,26 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
     draw on releasing capacity (including other victims' freed spots) —
     they are always pipelined rebinds, waiting for the old pods to vacate.
 
+    The loop runs over the (bounded) victim set, not the whole pod axis —
+    an M-length device loop at 50k running pods faults the TPU.  A
+    scenario with more than ``max_pods`` victims is rejected
+    (``all_ok=False``), mirroring MaxNumberConsolidationPreemptees-style
+    caps.
+
     Returns (free' [N, R], device_free' [N, D], moves [M] i32 node per
     victim, all_ok [])."""
     r, n = state.running, state.nodes
     M = r.m
     D = n.d
+    K = max(1, min(M, max_pods))
+    n_vic = jnp.sum(mask.astype(jnp.int32))
+    idxs = jnp.nonzero(mask, size=K, fill_value=0)[0]          # [K]
+    kvalid = jnp.arange(K) < n_vic
 
-    def body(m, carry):
+    def body(kk, carry):
         free_l, dev_l, moves, all_ok = carry
-        needed = mask[m]
+        m = idxs[kk]
+        needed = kvalid[kk] & mask[m]
         req = r.req[m]
         is_frac = r.device[m] >= 0
         # memory-based portions are node-relative: recompute for every
@@ -526,9 +704,353 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
         return free_l, dev_l, moves, all_ok
 
     return lax.fori_loop(
-        0, M, body,
+        0, K, body,
         (free, device_free, jnp.full((M,), -1, jnp.int32),
-         jnp.asarray(True)))
+         n_vic <= K))
+
+
+def _run_victim_action_chunked(
+    state: ClusterState,
+    fair_share: jax.Array,
+    result: AllocationResult,
+    *,
+    num_levels: int,
+    mode: str,                   # "reclaim" | "preempt"
+    config: VictimConfig,
+    remaining0: jax.Array,       # bool [G] viability-prefiltered
+    chain: jax.Array,
+    statics,
+    job_rank: jax.Array,
+    lq_tab: jax.Array | None,
+    cnt_q: jax.Array,
+    task_req_g: jax.Array,
+) -> AllocationResult:
+    """Wavefront victim search: B preemptors per iteration.
+
+    The sequential scan's per-step cost is dominated by fixed per-
+    preemptor machinery, so latency ∝ steps.  Chunking assigns each
+    lane a DISJOINT consecutive range of the shared eviction-unit order
+    (lane b consumes units ``(k_{b-1}, k_b]`` where ``k_b`` is the
+    smallest prefix whose freed capacity covers the chunk's cumulative
+    request — a vectorized searchsorted), so victim assignment cannot
+    conflict by construction; placements run vmapped against chunk-start
+    state and an allocate-style strict accept-prefix re-verifies the
+    composed capacity, queue-cap and fair-share gates.  Deviations from
+    the reference's one-preemptor-at-a-time order: the victim-job order
+    is frozen per action, and a lane's victims are a range of the
+    GLOBAL order (a reclaimer whose own queue's units fall inside its
+    range fails that chunk).  Preempt chunks draw all lanes from one
+    queue; per-pair reclaim-minruntime snapshots use the sequential
+    path (``VictimConfig.chunk_reclaim``).
+    """
+    reclaim = mode == "reclaim"
+    g, q, n, r = state.gangs, state.queues, state.nodes, state.running
+    G, T, M, Q = g.g, g.t, r.m, q.q
+    R_ = n.free.shape[1]
+    B = max(1, min(config.batch_size, G))
+    total = state.total_capacity
+    pcfg = config.placement
+    depth = (config.queue_depth_preempt
+             if mode == "preempt" and config.queue_depth_preempt is not None
+             else config.queue_depth)
+    base0, gang_runtime, pod_order = statics
+    quota_eff_q = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
+    limit_eff_q = jnp.where(q.limit <= UNLIMITED + 0.5, jnp.inf, q.limit)
+    gq = jnp.maximum(g.queue, 0)
+    # minruntime protection: preempt's resolved value is victim-side only
+    # (lane-independent); chunked reclaim is gated on no reclaim
+    # minruntime, so zeros there
+    if reclaim:
+        protected = jnp.zeros((G,), bool)
+    else:
+        mrt_g = q.preempt_min_runtime_eff[gq]
+        protected = (gang_runtime >= 0) & (gang_runtime < mrt_g)
+    gang_prio_pod = g.priority[jnp.maximum(r.gang, 0)]          # [M]
+
+    def chunk(carry):
+        res, remaining, q_att, fuel = carry
+        free, dev = res.free, res.device_free
+        qa = res.queue_allocated
+        qan = res.queue_allocated_nonpreemptible
+        extra, extra_dev = res.releasing_extra, res.device_releasing_extra
+        ext = res.extended_free
+        ext_extra = res.extended_releasing_extra
+
+        order = ordering.job_order_perm(
+            g, q, qa, fair_share, total, remaining)
+        if reclaim:
+            cand_g = order[:B]                                   # [B]
+            cand_valid = remaining[cand_g]
+        else:
+            # one queue per preempt chunk: victims and preemptors share
+            # the queue, so lanes must be comparable on one prio scale
+            q0 = g.queue[order[0]]
+            flags = remaining[order] & (g.queue[order] == q0)    # [G]
+            rank_v = jnp.cumsum(flags.astype(jnp.int32)) - 1
+            pos = jnp.where(flags & (rank_v < B), rank_v, B)
+            # unused lane slots get the out-of-range index G: their
+            # scatters drop instead of duplicating a live gang's index
+            # (duplicate scatter order is undefined)
+            cand_g = jnp.full((B + 1,), G, jnp.int32).at[pos].set(
+                order)[:B]
+            cand_valid = jnp.zeros((B + 1,), bool).at[pos].set(
+                True)[:B]
+
+        # ---- shared eviction-unit order (chunk-start state) -------------
+        already = res.victim
+        if reclaim:
+            cand_all = base0 & ~already
+        else:
+            cand_all = base0 & ~already & (r.queue == g.queue[cand_g[0]])
+        removed = res.victim & (res.victim_move < 0)
+        unit_rank, num_units = _rank_eviction_units(
+            state, cand_all, qa, fair_share, removed, protected,
+            pod_order, job_rank)
+        urank_safe = jnp.minimum(unit_rank, M)
+        m_req = jnp.where(cand_all[:, None], r.req, 0.0)
+        unit_req = jax.ops.segment_sum(
+            m_req, urank_safe, num_segments=M + 1)[:M]           # [U, R]
+        cum_freed = jnp.cumsum(unit_req, axis=0)
+        unit_leaf = jax.ops.segment_max(
+            jnp.where(cand_all, r.queue, -1), urank_safe,
+            num_segments=M + 1)[:M]                              # [U]
+
+        # ---- per-lane victim budget k_b ---------------------------------
+        lane_req = jnp.where(cand_valid[:, None],
+                             task_req_g[cand_g], 0.0)            # [B, R]
+        cum_req = jnp.cumsum(lane_req, axis=0)
+        cluster_free = jnp.sum(
+            jnp.where(n.valid[:, None], free + n.releasing + extra, 0.0),
+            axis=0)
+        targets = cum_req - cluster_free[None, :] - EPS
+        k_rb = jax.vmap(jnp.searchsorted, in_axes=(1, 1), out_axes=1)(
+            cum_freed, targets)                                  # [B, R]
+        k_b = jnp.max(k_rb, axis=1).astype(jnp.int32)            # [B]
+        k_prev = jnp.concatenate(
+            [jnp.full((1,), -1, jnp.int32), k_b[:-1]])
+
+        # ---- per-lane admissible range bound ----------------------------
+        queue_b = g.queue[cand_g]                                # [B]
+        if reclaim:
+            # Strategy pass per (unit, lane): the unit's leveled queue
+            # must still sit above fair share (or above deserved quota
+            # when the reclaimer is under its own quota) BEFORE the
+            # unit.  The subtree-cumulative freed is monotone along the
+            # unit order, so per (queue, resource) the over-share
+            # condition holds exactly for a PREFIX of units — one
+            # searchsorted per column replaces the [U, B, R] gathers.
+            leaf_safe = jnp.maximum(unit_leaf, 0)
+            contrib = chain[leaf_safe] & (unit_leaf >= 0)[:, None]
+            inc = contrib[:, :, None] * unit_req[:, None, :]     # [U, Q, R]
+            csum_excl = (jnp.cumsum(inc, axis=0) - inc).reshape(M, Q * R_)
+            bnd = jax.vmap(jnp.searchsorted, in_axes=(1, 0))(
+                csum_excl,
+                (qa - fair_share - EPS).reshape(-1))             # [Q*R]
+            bnd_fs = jnp.max(bnd.reshape(Q, R_), axis=1)         # [Q]
+            bnd2 = jax.vmap(jnp.searchsorted, in_axes=(1, 0))(
+                csum_excl,
+                jnp.where(jnp.isinf(quota_eff_q), -jnp.inf,
+                          qa - quota_eff_q - EPS).reshape(-1))
+            bnd_qt = jnp.max(bnd2.reshape(Q, R_), axis=1)        # [Q]
+            under_quota_b = jax.vmap(
+                lambda qi, tr: _ancestor_gate(
+                    q.parent, qi, num_levels, qa, q.quota, tr))(
+                        queue_b, lane_req)                       # [B]
+            bnd_eff = jnp.where(
+                under_quota_b[None, :],
+                jnp.maximum(bnd_fs, bnd_qt)[:, None],
+                bnd_fs[:, None])                                 # [Q, B]
+            lq_ub = lq_tab[leaf_safe][:, queue_b]                # [U, B]
+            bnd_u = jnp.take_along_axis(
+                bnd_eff, jnp.maximum(lq_ub, 0), axis=0)          # [U, B]
+            upos = jnp.arange(M)[:, None]
+            fail_ub = ((lq_ub >= 0) & (upos >= bnd_u)
+                       & (upos < num_units))                     # [U, B]
+            first_bad = jnp.where(
+                jnp.any(fail_ub, 0), jnp.argmax(fail_ub, 0), num_units)
+            hi_b = jnp.minimum(num_units, first_bad) - 1         # [B]
+        else:
+            hi_b = jnp.broadcast_to(num_units - 1, (B,)).astype(jnp.int32)
+
+        # ---- per-lane range validity ------------------------------------
+        if reclaim:
+            # a lane may not consume units of its own leaf queue
+            onehot = ((unit_leaf[:, None] == jnp.arange(Q)[None, :])
+                      & (unit_leaf >= 0)[:, None]).astype(jnp.int32)
+            cl = jnp.concatenate(
+                [jnp.zeros((1, Q), jnp.int32),
+                 jnp.cumsum(onehot, axis=0)])                    # [U+1, Q]
+            ksafe = jnp.clip(k_b, -1, M - 1)
+            own = (cl[ksafe + 1, queue_b]
+                   - cl[jnp.clip(k_prev, -1, M - 1) + 1, queue_b])
+            range_ok = own == 0
+        else:
+            # victim units are priority-ascending within the queue, so
+            # the range max is its last unit; it must sit strictly below
+            # the lane's priority
+            unit_prio = jax.ops.segment_max(
+                jnp.where(cand_all, gang_prio_pod, -BIG), urank_safe,
+                num_segments=M + 1)[:M]                          # [U]
+            range_ok = (unit_prio[jnp.clip(k_b, 0, M - 1)]
+                        < g.priority[cand_g])
+
+        # ---- lane gates --------------------------------------------------
+        nonpre_b = ~g.preemptible[cand_g]
+        gate_np_b = jax.vmap(
+            lambda qi, tr: _ancestor_gate(
+                q.parent, qi, num_levels, qan, q.quota, tr))(
+                    queue_b, lane_req)
+        gate_b = jnp.where(nonpre_b, gate_np_b, True)
+        if reclaim:
+            gate_b &= jax.vmap(
+                lambda qi, tr: _ancestor_gate(
+                    q.parent, qi, num_levels, qa, fair_share, tr))(
+                        queue_b, lane_req)
+        gate_b &= (cand_valid & (k_b <= hi_b) & range_ok
+                   & jnp.any(cand_all))
+
+        # ---- per-lane freed pools + vmapped placement attempts ----------
+        freed_n_b, freed_d_b, freed_q_b, freed_e_b = _freed_by_prefixes(
+            state, cand_all, unit_rank, k_b, chain)
+        extra_b = extra[None] + freed_n_b                        # [B, N, R]
+        extra_dev_b = extra_dev[None] + freed_d_b
+        ext_extra_b = ext_extra[None] + freed_e_b
+        qa_eff_b = qa[None] - freed_q_b                          # [B, Q, R]
+        lanes = jnp.arange(B, dtype=jnp.int32)
+        (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
+         bind_b, devbind_b, ext2_b, extbind_b) = jax.vmap(
+            lambda gi, lane, ex_n, ex_d, ex_e, qae: _attempt_gang(
+                state, gi, free, dev, qae, qan, num_levels, pcfg,
+                ex_n, ex_d, lane, chain, ext_free=ext,
+                extra_extended_releasing=ex_e))(
+            cand_g, lanes, extra_b, extra_dev_b, ext_extra_b, qa_eff_b)
+
+        ok_pre = gate_b & succ_b                                 # [B]
+        okm = ok_pre[:, None, None]
+        d_free = jnp.where(okm, free[None] - free2_b, 0.0)
+        d_bind = jnp.where(okm, bind_b, 0.0)
+        d_qa = jnp.where(okm, qa2_b - qa_eff_b, 0.0)
+        d_qan = jnp.where(okm, qan2_b - qan[None], 0.0)
+        cum_free_d = jnp.cumsum(d_free, axis=0)
+        cum_bind = jnp.cumsum(d_bind, axis=0)
+        cum_qa = jnp.cumsum(d_qa, axis=0)
+        cum_qan = jnp.cumsum(d_qan, axis=0)
+
+        rel_floor_b = -(n.releasing[None] + extra_b) - EPS
+        ok_node = jnp.all(free[None] - cum_free_d >= rel_floor_b,
+                          axis=(1, 2))
+        ok_bind = jnp.all(cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
+                          axis=(1, 2))
+        qa_comp = qa[None] - freed_q_b + cum_qa                  # [B, Q, R]
+        ok_qa = jnp.all((qa_comp <= limit_eff_q[None] + EPS)
+                        | (cum_qa <= EPS), axis=(1, 2))
+        ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff_q[None] + EPS)
+                         | (cum_qan <= EPS), axis=(1, 2))
+        accept = ok_node & ok_bind & ok_qa & ok_qan
+        if reclaim:
+            chain_b = chain[queue_b]                             # [B, Q]
+            accept &= jnp.all(
+                (qa_comp <= fair_share[None] + EPS)
+                | ~chain_b[:, :, None], axis=(1, 2))
+        if pcfg.track_devices:
+            d_dev = jnp.where(okm, dev[None] - dev2_b, 0.0)
+            d_devbind = jnp.where(okm, devbind_b, 0.0)
+            cum_dev = jnp.cumsum(d_dev, axis=0)
+            accept &= jnp.all(
+                dev[None] - cum_dev
+                >= -(n.device_releasing[None] + extra_dev_b) - EPS,
+                axis=(1, 2))
+            accept &= jnp.all(
+                jnp.cumsum(d_devbind, axis=0)
+                <= jnp.maximum(dev[None], 0.0) + EPS, axis=(1, 2))
+        if pcfg.extended:
+            d_ext = jnp.where(okm, ext[None] - ext2_b, 0.0)
+            cum_ext = jnp.cumsum(d_ext, axis=0)
+            accept &= jnp.all(
+                ext[None] - cum_ext
+                >= -(n.extended_releasing[None] + ext_extra_b) - EPS,
+                axis=(1, 2))
+            accept &= jnp.all(
+                jnp.cumsum(jnp.where(okm, extbind_b, 0.0), axis=0)
+                <= jnp.maximum(ext[None], 0.0) + EPS, axis=(1, 2))
+
+        # ---- strict accept prefix ---------------------------------------
+        bad = cand_valid & ~(ok_pre & accept)                    # [B]
+        bad_cum = jnp.cumsum(bad.astype(jnp.int32))
+        take = cand_valid & (bad_cum == 0)                       # [B]
+        first_fail = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
+        any_take = jnp.any(take)
+        k_star = jnp.max(jnp.where(take, k_b, -1))
+        star = jnp.argmax(jnp.where(take, k_b, -1))
+        victims = cand_all & (unit_rank <= k_star) & any_take
+
+        w = take.astype(free.dtype)
+        sel = lambda arr_b, base: jnp.where(any_take, arr_b[star], base)
+        res = res.replace(
+            free=free - jnp.einsum("b,bnr->nr", w, d_free),
+            device_free=(dev - jnp.einsum(
+                "b,bnd->nd", w, jnp.where(okm, dev[None] - dev2_b, 0.0))
+                if pcfg.track_devices else dev),
+            extended_free=(ext - jnp.einsum(
+                "b,bne->ne", w, jnp.where(okm, ext[None] - ext2_b, 0.0))
+                if pcfg.extended else ext),
+            releasing_extra=sel(extra_b, extra),
+            device_releasing_extra=sel(extra_dev_b, extra_dev),
+            extended_releasing_extra=sel(ext_extra_b, ext_extra),
+            queue_allocated=(sel(qa_eff_b, qa)
+                             + jnp.einsum("b,bqr->qr", w, d_qa)),
+            queue_allocated_nonpreemptible=(
+                qan + jnp.einsum("b,bqr->qr", w, d_qan)),
+            placements=res.placements.at[cand_g].set(
+                jnp.where(take[:, None], nodes_b,
+                          res.placements[cand_g])),
+            placement_device=res.placement_device.at[cand_g].set(
+                jnp.where(take[:, None], devt_b,
+                          res.placement_device[cand_g])),
+            pipelined=res.pipelined.at[cand_g].set(
+                jnp.where(take[:, None], pipe_b,
+                          res.pipelined[cand_g])),
+            allocated=res.allocated.at[cand_g].set(
+                res.allocated[cand_g] | take),
+            attempted=res.attempted.at[cand_g].set(
+                res.attempted[cand_g] | take | first_fail),
+            fit_reason=res.fit_reason.at[cand_g].set(
+                jnp.where(first_fail, 3, res.fit_reason[cand_g])),
+            victim=res.victim | victims,
+        )
+        done_b = take | first_fail
+        remaining = remaining.at[cand_g].set(
+            remaining[cand_g] & ~done_b)
+        if depth is not None:
+            q_att = q_att + jax.ops.segment_sum(
+                done_b.astype(jnp.int32), queue_b, num_segments=Q)
+            remaining = remaining & (q_att[g.queue] < depth)
+        if reclaim:
+            # live strategy-viability drop (see the sequential path)
+            qa_l = res.queue_allocated
+            under_g = jax.vmap(
+                lambda qi, tr: _ancestor_gate(
+                    q.parent, qi, num_levels, qa_l, q.quota, tr))(
+                        gq, task_req_g)
+            lqs2 = jnp.maximum(lq_tab, 0)
+            no_lq = lq_tab < 0
+            over_fs_vc = no_lq | jnp.any(
+                qa_l[lqs2] > fair_share[lqs2] + EPS, -1)
+            over_qt_vc = no_lq | jnp.any(
+                qa_l[lqs2] > quota_eff_q[lqs2] + EPS, -1)
+            diff = (jnp.arange(Q)[:, None] != jnp.arange(Q)[None, :])
+            has_v = (cnt_q > 0)[:, None] & diff
+            ev_fs_c = jnp.any(has_v & over_fs_vc, axis=0)
+            ev_qt_c = jnp.any(has_v & over_qt_vc, axis=0)
+            remaining = remaining & (
+                ev_fs_c[gq] | (under_g & ev_qt_c[gq]))
+        return res, remaining, q_att, fuel - 1
+
+    res, _, _, _ = lax.while_loop(
+        lambda c: jnp.any(c[1]) & (c[3] > 0), chunk,
+        (result, remaining0, jnp.zeros((Q,), jnp.int32),
+         jnp.asarray(G, jnp.int32)))
+    return res
 
 
 def run_victim_action(
@@ -555,10 +1077,21 @@ def run_victim_action(
     G = g.g
     total = state.total_capacity
     chain = _chain_membership(q.parent, num_levels)
-    steps = G if config.queue_depth is None else min(G, config.queue_depth)
+    depth = (config.queue_depth_preempt
+             if mode == "preempt" and config.queue_depth_preempt is not None
+             else config.queue_depth)
+    statics = victim_statics(state)
+    job_rank0 = frozen_job_rank(state, result.queue_allocated, fair_share)
+    quota_eff_q = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
+    if mode == "reclaim":
+        # [victim leaf, reclaimer leaf] leveled-queue table for the live
+        # strategy-viability drop inside `step`
+        qidx = jnp.arange(q.q)
+        lq_tab = jax.vmap(lambda v: jax.vmap(
+            lambda c: _leveled_queue(chain, q.depth, v, c))(qidx))(qidx)
 
     def step(carry):
-        res, remaining, fuel = carry
+        res, remaining, q_att, fuel = carry
         gi = ordering.select_next_gang(
             g, q, res.queue_allocated, fair_share, total, remaining)
         runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0) \
@@ -567,7 +1100,8 @@ def run_victim_action(
         def attempt(_):
             return solve_for_preemptor(
                 state, gi, res, fair_share, chain,
-                num_levels=num_levels, mode=mode, config=config)
+                num_levels=num_levels, mode=mode, config=config,
+                statics=statics, job_rank=job_rank0)
 
         def skip(_):
             T = g.t
@@ -577,13 +1111,16 @@ def run_victim_action(
                     jnp.full((state.running.m,), -1, jnp.int32),
                     res.free, res.device_free, res.releasing_extra,
                     res.device_releasing_extra, res.queue_allocated,
-                    res.queue_allocated_nonpreemptible, res.extended_free)
+                    res.queue_allocated_nonpreemptible, res.extended_free,
+                    res.extended_releasing_extra)
 
         (success, victims, nodes_t, dev_t, pipe_t, moves,
-         free2, dev2, extra2, extra_dev2, qa2, qan2, ext2) = lax.cond(
-             runnable, attempt, skip, None)
+         free2, dev2, extra2, extra_dev2, qa2, qan2, ext2,
+         ext_extra2) = lax.cond(runnable, attempt, skip, None)
         res = res.replace(
             extended_free=jnp.where(success, ext2, res.extended_free),
+            extended_releasing_extra=jnp.where(
+                success, ext_extra2, res.extended_releasing_extra),
             free=jnp.where(success, free2, res.free),
             device_free=jnp.where(success, dev2, res.device_free),
             releasing_extra=jnp.where(success, extra2, res.releasing_extra),
@@ -607,7 +1144,43 @@ def run_victim_action(
                                   res.victim_move),
         )
         remaining = remaining.at[gi].set(False)
-        return res, remaining, fuel - 1
+        if depth is not None:
+            # per-QUEUE attempt budget (ref QueueDepthPerAction: "max
+            # number of jobs to try for action per queue") — exhausted
+            # queues drain from the remaining set
+            q_att = q_att.at[g.queue[gi]].add(
+                runnable.astype(jnp.int32))
+            remaining = remaining & (
+                q_att[g.queue] < depth)
+        if mode == "reclaim":
+            # Live strategy-viability drop — SOUND because within the
+            # action victim-queue shares only fall and reclaimer
+            # allocation only grows, so a (victim queue, reclaimer) pair
+            # that stops being strategy-evictable never recovers.  A
+            # reclaimer gang stays in `remaining` only while some other
+            # leaf queue with candidates is still evictable for it; once
+            # shares exhaust, the loop ends in O(successes) steps instead
+            # of attempting every remaining pending gang.
+            # (cnt_q / task_req_g / gq / lq_tab / quota_eff_q are bound
+            # later in the enclosing scope, before the while_loop traces.)
+            qa_l = res.queue_allocated
+            under_g = jax.vmap(
+                lambda qi, tr: _ancestor_gate(
+                    q.parent, qi, num_levels, qa_l, q.quota, tr))(
+                        gq, task_req_g)                            # [G]
+            lqs = jnp.maximum(lq_tab, 0)
+            no_lq = lq_tab < 0
+            over_fs_vc = no_lq | jnp.any(
+                qa_l[lqs] > fair_share[lqs] + EPS, -1)             # [Q, Q]
+            over_qt_vc = no_lq | jnp.any(
+                qa_l[lqs] > quota_eff_q[lqs] + EPS, -1)
+            diff = (jnp.arange(q.q)[:, None] != jnp.arange(q.q)[None, :])
+            has_v = (cnt_q > 0)[:, None] & diff
+            ev_fs_c = jnp.any(has_v & over_fs_vc, axis=0)          # [Q]
+            ev_qt_c = jnp.any(has_v & over_qt_vc, axis=0)
+            remaining = remaining & (
+                ev_fs_c[gq] | (under_g & ev_qt_c[gq]))
+        return res, remaining, q_att, fuel - 1
 
     remaining0 = g.valid & (g.backoff <= 0) & ~result.allocated
 
@@ -663,11 +1236,30 @@ def run_victim_action(
                 fair_share, tr))(gq, task_req_g)
     elif mode == "consolidate":
         viable = viable & g.preemptible
+        # conservation gate: moving victims frees NOTHING in aggregate —
+        # a consolidation preemptor must fit the cluster's total spare
+        # capacity, or no rearrangement can ever place it.  On a
+        # saturated cluster this empties the action outright.
+        spare = jnp.sum(jnp.where(
+            state.nodes.valid[:, None],
+            result.free + state.nodes.releasing + result.releasing_extra,
+            0.0), axis=0)
+        viable = viable & jnp.all(task_req_g <= spare[None, :] + EPS,
+                                  axis=-1)
     remaining0 = remaining0 & viable
 
-    res, _, _ = lax.while_loop(
-        lambda c: jnp.any(c[1]) & (c[2] > 0), step,
-        (result, remaining0, jnp.asarray(steps, jnp.int32)))
+    if (config.batch_size > 1 and mode in ("reclaim", "preempt")
+            and (mode != "reclaim" or config.chunk_reclaim)):
+        return _run_victim_action_chunked(
+            state, fair_share, result, num_levels=num_levels, mode=mode,
+            config=config, remaining0=remaining0, chain=chain,
+            statics=statics, job_rank=job_rank0,
+            lq_tab=lq_tab if mode == "reclaim" else None,
+            cnt_q=cnt_q, task_req_g=task_req_g)
+    res, _, _, _ = lax.while_loop(
+        lambda c: jnp.any(c[1]) & (c[3] > 0), step,
+        (result, remaining0, jnp.zeros((q.q,), jnp.int32),
+         jnp.asarray(G, jnp.int32)))
     return res
 
 

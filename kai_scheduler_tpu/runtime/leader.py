@@ -1,0 +1,67 @@
+"""Leader election — lease-based HA gate for the cycle driver.
+
+Reference: ``cmd/scheduler/app/server.go:60-63`` — the scheduler runs
+under ``leaderelection`` with a Lease object (``resourcelock``); only
+the elected instance executes ``Scheduler.Run``.  Constants mirror the
+reference defaults (15s lease, 10s renew deadline, 2s retry).
+
+The ``Lease`` here is the coordination object: in-process it is shared
+directly between Scheduler instances (the envtest analogue); a
+deployment backs the same three fields (holder / acquire time / renew
+time) with its coordination store.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+#: reference defaults (client-go leaderelection)
+LEASE_DURATION_S = 15.0
+RETRY_PERIOD_S = 2.0
+
+
+@dataclasses.dataclass
+class Lease:
+    """coordination.k8s.io/Lease analogue."""
+
+    holder: str | None = None
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    duration_s: float = LEASE_DURATION_S
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
+
+    def try_acquire_or_renew(self, identity: str, now: float) -> bool:
+        """One election round (``tryAcquireOrRenew``): renew if held,
+        take over if expired, otherwise lose."""
+        with self._lock:
+            if self.holder == identity:
+                self.renew_time = now
+                return True
+            if self.holder is None or now - self.renew_time > self.duration_s:
+                self.holder = identity
+                self.acquire_time = now
+                self.renew_time = now
+                return True
+            return False
+
+    def release(self, identity: str) -> None:
+        """Voluntary step-down (``releaseOnCancel``)."""
+        with self._lock:
+            if self.holder == identity:
+                self.holder = None
+                self.renew_time = 0.0
+
+
+class LeaderElector:
+    """Per-instance view of a shared :class:`Lease`."""
+
+    def __init__(self, lease: Lease, identity: str):
+        self.lease = lease
+        self.identity = identity
+
+    def is_leader(self, now: float) -> bool:
+        return self.lease.try_acquire_or_renew(self.identity, now)
+
+    def resign(self) -> None:
+        self.lease.release(self.identity)

@@ -1,0 +1,111 @@
+"""Async status updater — batched writes off the cycle path.
+
+Reference: ``pkg/scheduler/cache/status_updater`` — PodGroup/pod
+condition and event writes go through a bounded worker pool
+(``status_updater/concurrency.go``, ``NumOfStatusRecordingWorkers``
+default 5) so a slow API server cannot stall the scheduling cycle; the
+cycle only ENQUEUES updates.
+
+Here the writer is any callable (the in-process ``Cluster`` mutation, or
+a real API client in a deployment); the updater owns the queue and the
+workers.  Updates for the same key coalesce (``inFlightPodGroups``
+semantics: a newer status for a pod group supersedes a queued one).
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable
+
+#: ref NumOfStatusRecordingWorkers (cache/cache.go), default 5
+DEFAULT_WORKERS = 5
+
+
+@dataclasses.dataclass
+class StatusUpdate:
+    """One queued write: ``key`` coalesces (latest wins), ``apply`` runs
+    on a worker."""
+
+    key: str
+    apply: Callable[[], Any]
+
+
+class AsyncStatusUpdater:
+    """Worker-pool status writer (``defaultStatusUpdater`` analogue)."""
+
+    def __init__(self, workers: int = DEFAULT_WORKERS):
+        self._queue: "queue.Queue[str | None]" = queue.Queue()
+        self._latest: dict[str, StatusUpdate] = {}
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._applied = 0
+        self._errors = 0
+        self._stopped = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(max(1, workers))]
+        for t in self._threads:
+            t.start()
+
+    # -- cycle side (non-blocking) ---------------------------------------
+
+    def enqueue(self, key: str, apply: Callable[[], Any]) -> None:
+        """Queue a write; a queued-but-unapplied write for the same key
+        is superseded (the reference keeps one in-flight record per pod
+        group)."""
+        with self._lock:
+            fresh = key not in self._latest
+            self._latest[key] = StatusUpdate(key, apply)
+        if fresh:
+            self._queue.put(key)
+
+    @property
+    def applied(self) -> int:
+        return self._applied
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._latest)
+
+    # -- worker side ------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            key = self._queue.get()
+            if key is None:
+                return
+            with self._lock:
+                update = self._latest.pop(key, None)
+                if update is not None:
+                    self._inflight += 1
+            if update is None:
+                continue
+            try:
+                update.apply()
+                self._applied += 1
+            except Exception:  # noqa: BLE001 — a failed write never
+                self._errors += 1  # stalls the pool (reference logs+drops)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait for the queue AND in-flight applies to drain."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                drained = not self._latest and self._inflight == 0
+            if drained and self._queue.empty():
+                return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self) -> None:
+        self._stopped = True
+        for _ in self._threads:
+            self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=5)

@@ -245,6 +245,9 @@ class RunningState(struct.PyTreeNode):
     #: node-filter class (consolidation moves must respect the pod's
     #: taints/affinity constraints on the target node)
     filter_class: jax.Array  # i32 [M]
+    #: extended (MIG) scalars actually held — credited back to the
+    #: scenario pools when the pod is victimised
+    extended: jax.Array      # f32 [M, E]
 
     @property
     def m(self) -> int:
@@ -314,6 +317,14 @@ class SnapshotIndex:
     has_subgroup_topology: bool = True
     has_extended_resources: bool = False
     extended_keys: list[str] = dataclasses.field(default_factory=list)
+    #: any queue configures reclaimMinRuntime — its per-(victim,
+    #: reclaimer) LCA tables are lane-dependent, so the chunked victim
+    #: path must stay off (see VictimConfig.chunk_reclaim)
+    has_reclaim_minruntime: bool = False
+    #: host (numpy) copies of the snapshot-side tables the commit path
+    #: reads — kept so cycle results never transfer them back from the
+    #: device (see framework.session._pack_commit)
+    host_tables: dict = dataclasses.field(default_factory=dict)
     #: feasibility spans the whole node axis: no selectors, filter
     #: classes, anti-affinity, or topology constraints in the snapshot
     dense_feasibility: bool = False
@@ -763,6 +774,7 @@ def build_snapshot(
         accel_held=np.zeros((M,), np.float32),
         accel_mem=np.zeros((M,), np.float32),
         filter_class=np.zeros((M,), np.int32),
+        extended=np.zeros((M, E), np.float32),
     )
     running_names: list[str] = [""] * M
     if now is None:
@@ -822,10 +834,13 @@ def build_snapshot(
             rk["queue"][:Mu] = np.where(has_grp, pg_queue[gsafe], 0)
             rk["priority"][:Mu] = np.where(has_grp, pg_prio[gsafe], 0)
             rk["preemptible"][:Mu] = has_grp & pg_pre[gsafe]
+            # -1 sentinel when the gang never started: the reference's
+            # minruntime protection returns NOT protected for a nil
+            # LastStartTimestamp (minruntime.go isPreemptMinRuntimeProtected)
             started = pg_start[gsafe]
             rk["runtime_s"][:Mu] = np.where(
                 has_grp & (started >= 0),
-                np.maximum(0.0, now - started), 0.0)
+                np.maximum(0.0, now - started), -1.0)
         np.add.at(gk["running_count"], gsafe[has_grp & ~r_rel], 1)
         # subgroup attribution: pods of plain gangs (no declared
         # subgroups) count toward the default slot 0 in bulk; only gangs
@@ -869,6 +884,7 @@ def build_snapshot(
                 ei = ext_index[ek]
                 taken = min(ev, float(ext_free[ni, ei]))
                 ext_free[ni, ei] -= taken
+                rk["extended"][j, ei] = taken
                 if pod.status == apis.PodStatus.RELEASING:
                     ext_rel[ni, ei] += taken
         vj = np.nonzero(vec)[0]
@@ -1053,44 +1069,50 @@ def build_snapshot(
                            gk["task_filter_class"][:, :1]) ==
                   gk["task_filter_class"][:, :1]).all()))
 
+    # assemble host-side (numpy) and ship with ONE device_put: per-array
+    # transfers cost a round trip each through a tunneled TPU
+    def _f(a):
+        return np.asarray(a, dtype) if a.dtype.kind == "f" else a
+
     state = ClusterState(
         nodes=NodeState(
-            allocatable=jnp.asarray(node_alloc, dtype),
-            free=jnp.asarray(node_free, dtype),
-            releasing=jnp.asarray(node_rel, dtype),
-            valid=jnp.asarray(node_valid),
-            labels=jnp.asarray(node_labels),
-            topology=jnp.asarray(node_topo),
-            device_free=jnp.asarray(dev_free, dtype),
-            device_releasing=jnp.asarray(dev_rel, dtype),
-            device_memory_gib=jnp.asarray(node_dev_mem, dtype),
-            filter_masks=jnp.asarray(filter_masks),
-            soft_scores=jnp.asarray(soft_scores, dtype),
-            extended_free=jnp.asarray(ext_free, dtype),
-            extended_releasing=jnp.asarray(ext_rel, dtype),
+            allocatable=_f(node_alloc),
+            free=_f(node_free),
+            releasing=_f(node_rel),
+            valid=node_valid,
+            labels=node_labels,
+            topology=node_topo,
+            device_free=_f(dev_free),
+            device_releasing=_f(dev_rel),
+            device_memory_gib=_f(node_dev_mem),
+            filter_masks=np.asarray(filter_masks),
+            soft_scores=_f(np.asarray(soft_scores, dtype)),
+            extended_free=_f(ext_free),
+            extended_releasing=_f(ext_rel),
         ),
         queues=QueueState(
-            parent=jnp.asarray(q_parent),
-            depth=jnp.asarray(q_depth),
-            priority=jnp.asarray(q_priority),
-            quota=jnp.asarray(q_quota, dtype),
-            over_quota_weight=jnp.asarray(q_oqw, dtype),
-            limit=jnp.asarray(q_limit, dtype),
-            allocated=jnp.asarray(q_alloc, dtype),
-            allocated_nonpreemptible=jnp.asarray(q_alloc_np, dtype),
-            request=jnp.asarray(q_request, dtype),
-            usage=jnp.asarray(q_usage, dtype),
-            fair_share=jnp.zeros((Q, R), dtype),
-            valid=jnp.asarray(q_valid),
-            creation_order=jnp.asarray(q_creation),
-            preempt_min_runtime=jnp.asarray(q_preempt_mrt, dtype),
-            reclaim_min_runtime=jnp.asarray(q_reclaim_mrt, dtype),
-            preempt_min_runtime_eff=jnp.asarray(q_preempt_eff, dtype),
-            reclaim_min_runtime_eff=jnp.asarray(q_reclaim_eff, dtype),
+            parent=q_parent,
+            depth=q_depth,
+            priority=q_priority,
+            quota=_f(q_quota),
+            over_quota_weight=_f(q_oqw),
+            limit=_f(q_limit),
+            allocated=_f(q_alloc),
+            allocated_nonpreemptible=_f(q_alloc_np),
+            request=_f(q_request),
+            usage=_f(q_usage),
+            fair_share=np.zeros((Q, R), dtype),
+            valid=q_valid,
+            creation_order=q_creation,
+            preempt_min_runtime=_f(q_preempt_mrt),
+            reclaim_min_runtime=_f(q_reclaim_mrt),
+            preempt_min_runtime_eff=_f(np.asarray(q_preempt_eff, dtype)),
+            reclaim_min_runtime_eff=_f(np.asarray(q_reclaim_eff, dtype)),
         ),
-        gangs=GangState(**{k: jnp.asarray(v) for k, v in gk.items()}),
-        running=RunningState(**{k: jnp.asarray(v) for k, v in rk.items()}),
+        gangs=GangState(**gk),
+        running=RunningState(**rk),
     )
+    state = jax.device_put(state)
     index = SnapshotIndex(
         node_names=node_names,
         queue_names=queue_names,
@@ -1107,8 +1129,20 @@ def build_snapshot(
             (gk["subgroup_required_level"] >= 0).any()),
         has_extended_resources=bool(ext_keys),
         extended_keys=ext_keys,
+        has_reclaim_minruntime=bool((q_reclaim_mrt > 0).any()),
+        host_tables={
+            "task_portion": gk["task_portion"],
+            "task_accel_mem": gk["task_accel_mem"],
+            "task_req0": np.ascontiguousarray(gk["task_req"][:, :, 0]),
+            "task_dra": gk["task_dra"],
+            "running_gang": rk["gang"],
+            "queue_usage": q_usage,
+        },
         dense_feasibility=(
             not selector_keys and len(filter_specs) == 1
+            # class-0 must actually span the node axis: untolerated
+            # NoSchedule/NoExecute taints shrink even the empty-spec mask
+            and bool(np.asarray(filter_masks)[0][node_valid].all())
             and bool((gk["anti_self_level"] < 0).all())
             and bool((gk["subgroup_required_level"] < 0).all())),
     )
