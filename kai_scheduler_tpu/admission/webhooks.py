@@ -89,3 +89,73 @@ class PodValidator:
             raise AdmissionError(
                 f"pod {pod.name}: whole-device accel request must be an"
                 f" integer, got {whole} (use fractions for sharing)")
+
+
+@dataclasses.dataclass
+class RuntimeEnforcement:
+    """Mutating hook — ref ``webhook/v1alpha2/runtimeenforcement``:
+    accelerator pods get the accelerator runtime class unless they set
+    their own (reservation pods are exempt in the reference; the TPU
+    runtime's equivalent knob is the runtime-class label)."""
+
+    name: str = "runtimeenforcement"
+    accel_runtime_class: str = "tpu-runtime"
+    RUNTIME_CLASS_LABEL = "kai.scheduler/runtime-class"
+
+    def validate(self, pod: apis.Pod) -> None:
+        return None
+
+    def mutate(self, pod: apis.Pod,
+               annotations: dict[str, str] | None = None,
+               labels: dict[str, str] | None = None) -> apis.Pod:
+        needs_accel = (pod.resources.accel > 0 or pod.accel_portion > 0
+                       or pod.accel_memory_gib > 0 or pod.dra_accel_count > 0
+                       or bool(pod.resource_claims))
+        if needs_accel and not pod.labels.get(self.RUNTIME_CLASS_LABEL):
+            pod.labels[self.RUNTIME_CLASS_LABEL] = self.accel_runtime_class
+        return pod
+
+
+@dataclasses.dataclass
+class GpuSharingGate:
+    """Validating hook — ref ``webhook/v1alpha2/gpusharing``: fractional
+    requests are rejected outright when sharing is disabled cluster-wide;
+    otherwise the request-shape checks of :class:`PodValidator` apply."""
+
+    name: str = "gpusharing"
+    sharing_enabled: bool = True
+
+    def validate(self, pod: apis.Pod) -> None:
+        if not self.sharing_enabled and (pod.accel_portion > 0
+                                         or pod.accel_memory_gib > 0):
+            raise AdmissionError(
+                f"pod {pod.name} requests accelerator sharing while GPU "
+                "sharing is disabled")
+        PodValidator().validate(pod)
+
+    def mutate(self, pod: apis.Pod,
+               annotations: dict[str, str] | None = None,
+               labels: dict[str, str] | None = None) -> apis.Pod:
+        return pod
+
+
+@dataclasses.dataclass
+class AdmissionChain:
+    """The admission plugin chain — ref ``admission/plugins/plugins.go``
+    registering podhooks + gpusharing + runtimeenforcement: every
+    incoming pod runs each plugin's Mutate then each plugin's Validate;
+    the first :class:`AdmissionError` rejects the pod."""
+
+    mutator: PodMutator = dataclasses.field(default_factory=PodMutator)
+    plugins: list = dataclasses.field(default_factory=lambda: [
+        GpuSharingGate(), RuntimeEnforcement()])
+
+    def admit(self, pod: apis.Pod,
+              annotations: dict[str, str] | None = None,
+              labels: dict[str, str] | None = None) -> apis.Pod:
+        pod = self.mutator.mutate(pod, annotations, labels)
+        for plugin in self.plugins:
+            pod = plugin.mutate(pod, annotations, labels)
+        for plugin in self.plugins:
+            plugin.validate(pod)
+        return pod

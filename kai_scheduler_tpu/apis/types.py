@@ -159,6 +159,17 @@ class Pod:
     #: accel accounting like whole devices (ref draGpuCounts; the claim
     #: allocation is recorded on the BindRequest)
     dra_accel_count: int = 0
+    #: names of ResourceClaim objects this pod consumes (ref
+    #: pod.spec.resourceClaims); when set, the claims' counts and their
+    #: DeviceClass constraints drive the DRA accounting instead of
+    #: ``dra_accel_count``
+    resource_claims: list[str] = dataclasses.field(default_factory=list)
+    #: PersistentVolumeClaim names (ref pod volumes → the VolumeBinding
+    #: predicate + the binder's volume binding plugin)
+    volume_claims: list[str] = dataclasses.field(default_factory=list)
+    #: host ports the pod needs exclusively on its node (ref the
+    #: NodePorts predicate)
+    host_ports: list[int] = dataclasses.field(default_factory=list)
     creation_timestamp: float = 0.0
 
 
@@ -393,14 +404,79 @@ class BindRequest:
     #: device indices chosen by the scheduler (fractional: the shared
     #: device; whole: filled by the binder) — ref SelectedGPUGroups
     selected_accel_groups: list[int] = dataclasses.field(default_factory=list)
-    #: devices satisfied through DRA ResourceClaims — ref
-    #: ResourceClaimAllocations; count equals the pod's dra_accel_count
-    resource_claim_allocations: list[int] = dataclasses.field(
+    #: DRA claims this bind must allocate — claim NAMES when the pod
+    #: declares ResourceClaims (the binder resolves concrete devices and
+    #: records them on the claim objects), legacy integer placeholders
+    #: for bare ``dra_accel_count`` pods — ref ResourceClaimAllocations
+    resource_claim_allocations: list = dataclasses.field(
         default_factory=list)
     backoff_limit: int = 3
     #: filled by the binder
     phase: str = "Pending"   # Pending | Succeeded | Failed
     failures: int = 0
+
+
+@dataclasses.dataclass
+class StorageClass:
+    """ref ``api/storageclass_info`` — bind mode + topology restriction
+    (the storagecapacity/csidriver surface reduced to what placement
+    actually consumes)."""
+
+    name: str
+    #: "Immediate" or "WaitForFirstConsumer" (volume binds at PreBind)
+    bind_mode: str = "WaitForFirstConsumer"
+    #: node-label constraints where volumes of this class can exist
+    #: (allowedTopologies)
+    allowed_topology: dict[str, str] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class PersistentVolumeClaim:
+    """ref ``api/storageclaim_info`` — the VolumeBinding predicate's
+    subject.  A BOUND claim pins pods to its volume's topology
+    (``node_affinity``); an unbound WaitForFirstConsumer claim restricts
+    to its class's allowed topology and binds at PreBind."""
+
+    name: str
+    storage_class: str = ""
+    capacity_gib: float = 0.0
+    bound: bool = False
+    #: the bound volume's topology (zone/hostname labels) — pods using
+    #: the claim must land on matching nodes
+    node_affinity: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DeviceClass:
+    """DRA device selection — ref resource.k8s.io DeviceClass with CEL
+    selectors (``plugins/dynamicresources/dynamicresources.go:30-70``).
+    On the structured device model the CEL surface degenerates to the
+    attributes devices actually expose here: per-device memory and the
+    owning node's labels."""
+
+    name: str
+    #: device must have at least this much memory (CEL
+    #: ``device.capacity['memory']`` comparisons)
+    min_memory_gib: float = 0.0
+    #: node-label constraints (CEL node attribute selectors)
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ResourceClaim:
+    """DRA ResourceClaim — ref resource.k8s.io ResourceClaim; allocation
+    status is written by the binder (ref ``bindResourceClaims`` in the
+    k8s-plugins binder plugin)."""
+
+    name: str
+    device_class: str = ""
+    #: devices requested (ref exactCount)
+    count: int = 1
+    #: allocation status — set by the binder, cleared on rollback
+    node: str | None = None
+    devices: list[int] = dataclasses.field(default_factory=list)
+    owner_pod: str | None = None
 
 
 @dataclasses.dataclass

@@ -287,7 +287,11 @@ class Scheduler:
         session = Session.open(
             *self._shard_filter(*cluster.snapshot_lists()),
             config=self.config.session,
-            now=cluster.now, queue_usage=queue_usage)
+            now=cluster.now, queue_usage=queue_usage,
+            resource_claims=cluster.resource_claims,
+            device_classes=cluster.device_classes,
+            volume_claims=cluster.volume_claims,
+            storage_classes=cluster.storage_classes)
         open_s = time.perf_counter() - t0
         metrics.open_session_latency.observe(value=open_s)
         result = CycleResult(tensors=init_result(session.state))

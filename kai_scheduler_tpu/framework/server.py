@@ -57,11 +57,114 @@ def job_order(cluster: Cluster, scheduler: Scheduler) -> list[dict]:
     return out
 
 
+def profile_cycle(cluster: Cluster, scheduler: Scheduler,
+                  top: int = 25) -> dict:
+    """One scheduling cycle under cProfile — the pprof
+    ``/debug/pprof/profile`` analogue (ref ``cmd/scheduler/profiling``):
+    returns the hottest host-side functions plus the cycle's phase
+    timings (device time shows up as the blocking transfer)."""
+    import copy
+    import cProfile
+    import pstats
+
+    # profile against a private copy: a profiling GET must never write
+    # bind requests or evictions into the server's stored cluster
+    cluster = copy.deepcopy(cluster)
+    prof = cProfile.Profile()
+    prof.enable()
+    result = scheduler.run_once(cluster)
+    prof.disable()
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative")
+    rows = []
+    for func, (cc, nc, tt, ct, _) in stats.stats.items():  # type: ignore
+        fname, line, name = func
+        rows.append({"function": f"{fname}:{line}({name})",
+                     "calls": nc, "total_s": round(tt, 6),
+                     "cumulative_s": round(ct, 6)})
+    rows.sort(key=lambda r: -r["cumulative_s"])
+    return {
+        "open_seconds": result.open_seconds,
+        "commit_seconds": result.commit_seconds,
+        "total_seconds": result.session_seconds,
+        "action_seconds": result.action_seconds,
+        "hottest": rows[:top],
+    }
+
+
+def apply_cluster_delta(cluster: Cluster, delta: dict) -> None:
+    """Apply an incremental update to the stored cluster — the
+    delta/incremental wire protocol: instead of shipping the full
+    cluster document every cycle (tens of MB at 10k nodes × 50k pods),
+    a sidecar PATCHes only what changed.  Collections accept
+    ``{collection}_upsert`` (object docs) and ``{collection}_delete``
+    (names); ``now`` advances the clock."""
+    from ..apis import types as apis
+    from ..runtime import snapshot as snap
+    defaults = {
+        "nodes": lambda: snap._to_jsonable(apis.Node(name="")),
+        "queues": lambda: snap._to_jsonable(apis.Queue(name="")),
+        "pod_groups": lambda: snap._to_jsonable(
+            apis.PodGroup(name="", queue="")),
+        "pods": lambda: snap._to_jsonable(apis.Pod(name="", group="")),
+        "bind_requests": lambda: snap._to_jsonable(
+            apis.BindRequest(pod_name="", selected_node="")),
+    }
+    defaults.update({
+        "resource_claims": lambda: snap._to_jsonable(
+            apis.ResourceClaim(name="")),
+        "device_classes": lambda: snap._to_jsonable(
+            apis.DeviceClass(name="")),
+        "volume_claims": lambda: snap._to_jsonable(
+            apis.PersistentVolumeClaim(name="")),
+        "storage_classes": lambda: snap._to_jsonable(
+            apis.StorageClass(name="")),
+    })
+    parsers = {
+        "nodes": (snap._node, cluster.nodes),
+        "queues": (snap._queue, cluster.queues),
+        "pod_groups": (snap._pod_group, cluster.pod_groups),
+        "pods": (snap._pod, cluster.pods),
+        "bind_requests": (snap._bind_request, cluster.bind_requests),
+        "resource_claims": (
+            lambda d: apis.ResourceClaim(**d), cluster.resource_claims),
+        "device_classes": (
+            lambda d: apis.DeviceClass(**d), cluster.device_classes),
+        "volume_claims": (
+            lambda d: apis.PersistentVolumeClaim(**d),
+            cluster.volume_claims),
+        "storage_classes": (
+            lambda d: apis.StorageClass(**d), cluster.storage_classes),
+    }
+    for coll, (parse, store) in parsers.items():
+        for doc in delta.get(f"{coll}_upsert", []):
+            # partial documents merge over the EXISTING object when the
+            # key is already stored (a delta only carries the fields
+            # that changed), over defaults for new objects
+            key0 = doc.get("name") or doc.get("pod_name")
+            if key0 in store:
+                full = snap._to_jsonable(store[key0])
+            else:
+                full = defaults[coll]()
+            full.update(doc)
+            obj = parse(full)
+            key = getattr(obj, "name", None) or obj.pod_name
+            store[key] = obj
+        for name in delta.get(f"{coll}_delete", []):
+            store.pop(name, None)
+    if "now" in delta:
+        cluster.now = float(delta["now"])
+
+
 def run_cycle_doc(doc: dict, scheduler: Scheduler | None = None) -> dict:
     """POST /cycle body → commit-set document (the sidecar protocol)."""
     cluster = load_cluster(doc)
     scheduler = scheduler or Scheduler()
     result = scheduler.run_once(cluster)
+    return _commit_doc(result)
+
+
+def _commit_doc(result) -> dict:
     return {
         "bind_requests": [{
             "pod": br.pod_name, "node": br.selected_node,
@@ -101,6 +204,10 @@ class SchedulerServer:
                     self._send(job_order(outer.cluster, outer.scheduler))
                 elif self.path == "/snapshot":
                     self._send(dump_cluster(outer.cluster))
+                elif self.path.startswith("/debug/pprof"):
+                    # the --enable-profiler pprof endpoint analogue
+                    self._send(profile_cycle(outer.cluster,
+                                             outer.scheduler))
                 elif self.path == "/metrics":
                     body = metrics.registry.render().encode()
                     self.send_response(200)
@@ -113,13 +220,29 @@ class SchedulerServer:
                     self.send_error(404)
 
             def do_POST(self):  # noqa: N802
-                if self.path != "/cycle":
-                    self.send_error(404)
-                    return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
-                    doc = json.loads(self.rfile.read(length).decode())
-                    self._send(run_cycle_doc(doc, outer.scheduler))
+                    if self.path == "/cycle":
+                        doc = json.loads(self.rfile.read(length).decode())
+                        self._send(run_cycle_doc(doc, outer.scheduler))
+                    elif self.path == "/cluster":
+                        # replace the stored cluster (upload once ...)
+                        doc = json.loads(self.rfile.read(length).decode())
+                        outer.cluster = load_cluster(doc)
+                        self._send({"ok": True})
+                    elif self.path == "/cluster/delta":
+                        # ... then PATCH deltas instead of re-shipping
+                        # the full document every cycle
+                        doc = json.loads(self.rfile.read(length).decode())
+                        apply_cluster_delta(outer.cluster, doc)
+                        self._send({"ok": True})
+                    elif self.path == "/cycle/stored":
+                        # run a cycle against the stored cluster: the
+                        # incremental sidecar protocol's execute step
+                        result = outer.scheduler.run_once(outer.cluster)
+                        self._send(_commit_doc(result))
+                    else:
+                        self.send_error(404)
                 except Exception as exc:  # noqa: BLE001
                     self.send_error(400, str(exc))
 

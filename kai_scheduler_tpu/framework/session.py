@@ -234,8 +234,11 @@ class Session:
             np.rint(host["task_req0"][gi, ti]).astype(np.int64))
         dev = devices[gi, ti]
         dra = host["task_dra"][gi, ti]
-        # DRA claim allocations: the binder resolves concrete devices; the
-        # record carries the claimed count (ref ResourceClaimAllocations)
+        # DRA claim allocations: pods with real ResourceClaims record the
+        # claim NAMES (the binder allocates concrete devices onto the
+        # claim objects); bare dra_accel_count pods keep legacy integer
+        # placeholders (ref ResourceClaimAllocations)
+        claims = self.index.claims_by_pod
         frac_t = apis.ReceivedResourceType.FRACTION
         reg_t = apis.ReceivedResourceType.REGULAR
         backoff = self.config.default_bind_backoff_limit
@@ -248,7 +251,8 @@ class Session:
                 received_accel_memory_gib=me,
                 received_accel_count=ct,
                 selected_accel_groups=[dv] if dv >= 0 else [],
-                resource_claim_allocations=list(range(dr)),
+                resource_claim_allocations=(
+                    claims.get(nm) or list(range(dr))),
                 backoff_limit=backoff,
             )
             for nm, nn, fr, po, me, ct, dv, dr in zip(

@@ -257,7 +257,8 @@ def _attempt_gang_in_domain(
         prior_nodes: jax.Array | None = None,  # i32 [T] prior placements
         quota: jax.Array | None = None,    # i32 [] max new placements
         ext_free: jax.Array | None = None,  # f32 [N, E] extended pool
-        extra_extended_releasing: jax.Array | None = None  # f32 [N, E]
+        extra_extended_releasing: jax.Array | None = None,  # f32 [N, E]
+        banned_doms: jax.Array | None = None  # i32 [S] domains to avoid
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -458,6 +459,10 @@ def _attempt_gang_in_domain(
             dom_ok = jnp.all(
                 node_agg + EPS >= sub_rem[s_t][None, :],
                 axis=-1) & (dom_col >= 0)
+            if banned_doms is not None:
+                # in-cycle retry after a fragmented-domain failure: the
+                # previously locked domain is off the table this attempt
+                dom_ok = dom_ok & (dom_col != banned_doms[s_t])
             allowed = allowed & (~needs_pick | dom_ok)
             # binpack the domain choice: fullest fitting domain first
             # (ref topology/node_scoring.go domain ordering) — scaled
@@ -598,8 +603,8 @@ def _attempt_gang_in_domain(
     # victim solver) — unrolling T copies made compile time the suite's
     # bottleneck while saving only ~µs of loop overhead per step
     carry = lax.fori_loop(0, T, task_body, carry)
-    (free2, dev2, ext2, bind_used, dev_bind, ext_bind, _, _, _, _,
-     nodes_t, dev_t, pipe_t, count, q_delta, _) = carry
+    (free2, dev2, ext2, bind_used, dev_bind, ext_bind, _, sub_dom_out, _,
+     _, nodes_t, dev_t, pipe_t, count, q_delta, _) = carry
     # queue accounting applied once for the whole gang along its chain
     qa2 = q_alloc + anc[:, None] * q_delta[None, :]
     qan2 = q_alloc_np + jnp.where(nonpreempt,
@@ -613,7 +618,7 @@ def _attempt_gang_in_domain(
         # re-push protocol: the attempt's chunk is all-or-nothing
         success = (goal > 0) & (count >= goal)
     return (free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, success,
-            bind_used, dev_bind, ext2, ext_bind)
+            bind_used, dev_bind, ext2, ext_bind, sub_dom_out)
 
 
 def _attempt_gang_in_domain_uniform(
@@ -627,7 +632,9 @@ def _attempt_gang_in_domain_uniform(
         prior_nodes: jax.Array | None = None,
         quota: jax.Array | None = None,
         ext_free: jax.Array | None = None,
-        extra_extended_releasing: jax.Array | None = None):
+        extra_extended_releasing: jax.Array | None = None,
+        banned_doms: jax.Array | None = None,
+        topo_tables=None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
     A gang whose T pending tasks are identical replicas (the dominant
@@ -722,24 +729,47 @@ def _attempt_gang_in_domain_uniform(
         has_req = srl0 >= 0
         dom_col = jnp.take(n.topology, jnp.clip(srl0, 0, L - 1), axis=1)
         NDu = N * L
-        ids = jnp.where(n.valid & (dom_col >= 0), dom_col, NDu)
-        dom_caps = jax.ops.segment_sum(
-            c_pipe, ids, num_segments=NDu + 1)[:NDu]     # [ND] replicas
-        avail_accel = (free[:, 0] + n.releasing[:, 0]
-                       + extra_releasing[:, 0])
-        agg_accel = jax.ops.segment_sum(
-            jnp.where(n.valid, avail_accel, 0.0), ids,
-            num_segments=NDu + 1)[:NDu]
         want0 = jnp.minimum(goal if not legacy else tcount, m_gate)
-        fits_dom = dom_caps >= jnp.maximum(want0, 1)
-        # spread wavefront lanes across the fitting domains, fullest
-        # first: lane 0 takes the binpack choice, lane k the k-th-fullest
-        # — otherwise every lane of a chunk fills the same domain and the
-        # accept prefix caps at one domain's capacity
-        order_dom = jnp.argsort(jnp.where(fits_dom, agg_accel, jnp.inf))
-        n_fit = jnp.sum(fits_dom.astype(jnp.int32))
-        target = order_dom[jnp.mod(lane, jnp.maximum(n_fit, 1))]
-        target = jnp.where(jnp.any(fits_dom), target, -1)
+        if topo_tables is not None:
+            # chunk-hoisted tables (see allocate()): per-lane work is
+            # gathers + one cumsum — the vmapped per-lane argsort +
+            # segment-sums over the domain axis dominated the wavefront
+            # at 5k nodes
+            dom_caps_y, level_of_dom, order_by_agg = topo_tables
+            dom_caps = dom_caps_y[g.task_type[gang_idx, 0]]   # [ND]
+            fits_dom = ((dom_caps >= jnp.maximum(want0, 1))
+                        & (level_of_dom == srl0))
+            if banned_doms is not None:
+                fits_dom = fits_dom & (
+                    jnp.arange(NDu) != jnp.maximum(banned_doms[0], -1))
+            fs = fits_dom[order_by_agg]
+            n_fit = jnp.sum(fs.astype(jnp.int32))
+            sel = jnp.mod(lane, jnp.maximum(n_fit, 1)) + 1
+            pos = jnp.argmax(fs & (jnp.cumsum(fs.astype(jnp.int32))
+                                   == sel))
+            target = jnp.where(n_fit > 0, order_by_agg[pos], -1)
+        else:
+            ids = jnp.where(n.valid & (dom_col >= 0), dom_col, NDu)
+            dom_caps = jax.ops.segment_sum(
+                c_pipe, ids, num_segments=NDu + 1)[:NDu]  # [ND] replicas
+            avail_accel = (free[:, 0] + n.releasing[:, 0]
+                           + extra_releasing[:, 0])
+            agg_accel = jax.ops.segment_sum(
+                jnp.where(n.valid, avail_accel, 0.0), ids,
+                num_segments=NDu + 1)[:NDu]
+            fits_dom = dom_caps >= jnp.maximum(want0, 1)
+            if banned_doms is not None:
+                fits_dom = fits_dom & (
+                    jnp.arange(NDu) != jnp.maximum(banned_doms[0], -1))
+            # spread wavefront lanes across the fitting domains, fullest
+            # first: lane 0 takes the binpack choice, lane k the k-th-
+            # fullest — otherwise every lane of a chunk fills the same
+            # domain and the accept prefix caps at one domain's capacity
+            order_dom = jnp.argsort(
+                jnp.where(fits_dom, agg_accel, jnp.inf))
+            n_fit = jnp.sum(fits_dom.astype(jnp.int32))
+            target = order_dom[jnp.mod(lane, jnp.maximum(n_fit, 1))]
+            target = jnp.where(jnp.any(fits_dom), target, -1)
         prior_dom = jnp.where(
             jnp.any(already),
             dom_col[jnp.maximum(prior_nodes[jnp.argmax(already)], 0)], -1)
@@ -751,6 +781,9 @@ def _attempt_gang_in_domain_uniform(
         fit_idle = fit_idle & in_dom
         fit_pipe = fit_pipe & in_dom
         c_pipe = jnp.where(in_dom, c_pipe, 0)
+        target_out = jnp.where(has_req, target, -1)
+    else:
+        target_out = jnp.asarray(-1, jnp.int32)
 
     c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
 
@@ -823,9 +856,11 @@ def _attempt_gang_in_domain_uniform(
     # uniform_gangs off when any exist) — pass the pool through untouched
     if ext_free is None:
         ext_free = state.nodes.extended_free
+    sub_dom_out = jnp.full((g.s,), -1, jnp.int32).at[0].set(
+        target_out.astype(jnp.int32))
     return (free2, device_free, qa2, qan2, nodes_t, dev_t, pipe_t, success,
             bind_used, jnp.zeros_like(device_free), ext_free,
-            jnp.zeros_like(ext_free))
+            jnp.zeros_like(ext_free), sub_dom_out)
 
 
 def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
@@ -839,7 +874,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   prior_nodes: jax.Array | None = None,
                   quota: jax.Array | None = None,
                   ext_free: jax.Array | None = None,
-                  extra_extended_releasing: jax.Array | None = None):
+                  extra_extended_releasing: jax.Array | None = None,
+                  topo_tables=None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -873,11 +909,33 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     else:
         in_domain = _attempt_gang_in_domain
 
-    return in_domain(
-        state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-        num_levels, config, n.valid, pref_doms, has_pref,
-        extra_releasing, extra_device_releasing, lane, chain,
-        prior_nodes, quota, ext_free, extra_extended_releasing)
+    def run(banned):
+        extras = ((topo_tables,) if config.uniform_tasks else ())
+        return in_domain(
+            state, gang_idx, free, device_free, q_alloc, q_alloc_np,
+            num_levels, config, n.valid, pref_doms, has_pref,
+            extra_releasing, extra_device_releasing, lane, chain,
+            prior_nodes, quota, ext_free, extra_extended_releasing,
+            banned, *extras)
+
+    out = run(None)
+    if config.subgroup_topology and not config.uniform_tasks:
+        # In-cycle retry over the NEXT domain: the aggregate-capacity
+        # domain gate stands in for allocateSubGroupSet's per-subset
+        # rollback search, so a fragmented domain can pass the gate and
+        # fail the fill — one bounded retry with the failed attempt's
+        # locked domains banned places the gang in the next-fullest
+        # domain within the same cycle instead of waiting one out.
+        # The uniform kernel needs no retry: its domain pick counts real
+        # per-node replica capacities, so a picked domain always fits.
+        # (Under the wavefront vmap this cond lowers to a select that
+        # executes both branches — tolerable on the B<=64 per-task path,
+        # ruinous on the wide uniform path.)
+        success1, sub_dom1 = out[7], out[12]
+        retry_ok = ~success1 & jnp.any(sub_dom1 >= 0)
+        out = lax.cond(retry_ok, lambda _: run(sub_dom1),
+                       lambda _: out, None)
+    return out[:12]
 
 
 def allocate(
@@ -943,12 +1001,67 @@ def allocate(
 
     chain = _chain_membership(q.parent, num_levels)
 
-    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan, ext):
+    L = n.topology.shape[1]
+    ND = n.n * L
+    hoist_topo = config.uniform_tasks and config.subgroup_topology
+    if hoist_topo:
+        # domain-id → topology level (the global dense id space spans
+        # all levels; each id belongs to exactly one)
+        level_of_dom = jnp.full((ND + 1,), -1, jnp.int32)
+        for lvl in range(L):
+            ids_l = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
+                              n.topology[:, lvl], ND)
+            level_of_dom = level_of_dom.at[ids_l].set(lvl)
+        level_of_dom = level_of_dom[:ND]
+
+    def topo_tables_for(free, dev, qa):
+        """Chunk-hoisted domain tables for the uniform+topology path:
+        per-TYPE replica capacity per domain and ONE fullest-first
+        domain order — the per-lane argsort/segment-sums they replace
+        dominated the wavefront (they are lane-independent)."""
+        avail = free + n.releasing + extra
+        zero = jnp.zeros((), free.dtype)
+
+        def caps_of_type(y):
+            _, fp = feasible_nodes_dual(
+                n, g.type_req[y], g.type_selector[y], zero, zero,
+                free=free, device_free=dev, extra_releasing=extra,
+                extra_device_releasing=extra_dev, devices=False,
+                task_class=g.type_class[y])
+            req = g.type_req[y]
+            c = jnp.where(req > EPS,
+                          (avail + EPS) / jnp.maximum(req, EPS)[None, :],
+                          jnp.inf)
+            c = jnp.floor(jnp.min(c, axis=-1))
+            c = jnp.where(fp & n.valid,
+                          jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+            caps = jnp.zeros((ND + 1,), jnp.int32)
+            for lvl in range(L):
+                ids_l = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
+                                  n.topology[:, lvl], ND)
+                caps = caps.at[ids_l].add(c)
+            return caps[:ND]
+
+        dom_caps_y = jax.vmap(caps_of_type)(
+            jnp.arange(g.type_req.shape[0]))                 # [Y, ND]
+        agg = jnp.zeros((ND + 1,), free.dtype)
+        for lvl in range(L):
+            ids_l = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
+                              n.topology[:, lvl], ND)
+            agg = agg.at[ids_l].add(
+                jnp.where(n.valid, avail[:, 0], 0.0))
+        order_by_agg = jnp.argsort(
+            jnp.where(level_of_dom >= 0, agg[:ND], jnp.inf))
+        return dom_caps_y, level_of_dom, order_by_agg
+
+    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan, ext,
+                    topo_tables):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
                              config, extra, extra_dev, lane, chain,
                              prior_nodes=prior, quota=quota, ext_free=ext,
                              extra_extended_releasing=init.
-                             extended_releasing_extra)
+                             extended_releasing_extra,
+                             topo_tables=topo_tables)
 
     def cond(carry):
         res, remaining, q_attempts, failed_sig, fuel = carry
@@ -1009,11 +1122,14 @@ def allocate(
         # instead of colliding on one
         lanes = jnp.arange(B, dtype=jnp.int32)
         ext = res.extended_free
+        tables = topo_tables_for(free, dev, qa) if hoist_topo else None
         (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
          bind_b, devbind_b, ext2_b, extbind_b) = \
             jax.vmap(attempt_one,
-                     in_axes=(0, 0, 0, 0, None, None, None, None, None))(
-                cand, lanes, prior_b, quota_b, free, dev, qa, qan, ext)
+                     in_axes=(0, 0, 0, 0, None, None, None, None, None,
+                              None))(
+                cand, lanes, prior_b, quota_b, free, dev, qa, qan, ext,
+                tables)
         succ_b = succ_b & cand_valid
 
         ok = succ_b[:, None, None]

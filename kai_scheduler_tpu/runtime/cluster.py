@@ -28,6 +28,16 @@ class Cluster:
     pods: dict[str, apis.Pod] = dataclasses.field(default_factory=dict)
     topology: apis.Topology | None = None
     bind_requests: dict[str, apis.BindRequest] = dataclasses.field(default_factory=dict)
+    #: DRA objects (ref populateDRAGPUs + SharedDRAManager state)
+    resource_claims: dict[str, apis.ResourceClaim] = dataclasses.field(
+        default_factory=dict)
+    device_classes: dict[str, apis.DeviceClass] = dataclasses.field(
+        default_factory=dict)
+    #: storage objects (ref storage{class,claim} info structs)
+    volume_claims: dict[str, apis.PersistentVolumeClaim] = dataclasses.field(
+        default_factory=dict)
+    storage_classes: dict[str, apis.StorageClass] = dataclasses.field(
+        default_factory=dict)
     #: monotonic clock advanced by the simulation driver
     now: float = 0.0
     #: evicted pods whose workload controller will recreate them (the
@@ -114,6 +124,12 @@ class Cluster:
         bookkeeping (``binder/binding/resourcereservation``)."""
         node = self.nodes[node_name]
         free = [1.0] * int(round(node.allocatable.accel))
+        # devices held through allocated DRA claims are not free either
+        for claim in self.resource_claims.values():
+            if claim.node == node_name:
+                for d in claim.devices:
+                    if d < len(free):
+                        free[d] = 0.0
         for pod in self.pods.values():
             if pod.node != node_name or pod.status not in (
                     apis.PodStatus.BOUND, apis.PodStatus.RUNNING,
@@ -192,6 +208,13 @@ class Cluster:
         for name in list(self.pods):
             pod = self.pods[name]
             if pod.status == apis.PodStatus.RELEASING:
+                # the pod's DRA claims deallocate with it (ref claim
+                # deallocation on pod deletion)
+                for claim in self.resource_claims.values():
+                    if claim.owner_pod == name:
+                        claim.node = None
+                        claim.devices = []
+                        claim.owner_pod = None
                 if name in self.restarting:
                     self.restarting.discard(name)
                     pod.status = apis.PodStatus.PENDING

@@ -119,9 +119,19 @@ def dump_cluster(cluster: Cluster) -> dict:
         "queues": [_to_jsonable(q) for q in cluster.queues.values()],
         "pod_groups": [_to_jsonable(g) for g in cluster.pod_groups.values()],
         "pods": [_to_jsonable(p) for p in cluster.pods.values()],
-        "topology": _to_jsonable(cluster.topology),
+        "topology": ([_to_jsonable(t) for t in cluster.topology]
+                     if isinstance(cluster.topology, list)
+                     else _to_jsonable(cluster.topology)),
         "bind_requests": [_to_jsonable(b)
                           for b in cluster.bind_requests.values()],
+        "resource_claims": [_to_jsonable(c)
+                            for c in cluster.resource_claims.values()],
+        "device_classes": [_to_jsonable(c)
+                           for c in cluster.device_classes.values()],
+        "volume_claims": [_to_jsonable(c)
+                          for c in cluster.volume_claims.values()],
+        "storage_classes": [_to_jsonable(c)
+                            for c in cluster.storage_classes.values()],
         "restarting": sorted(cluster.restarting),
     }
 
@@ -130,8 +140,11 @@ def load_cluster(doc: dict) -> Cluster:
     """Inverse of :func:`dump_cluster`."""
     if doc.get("version") != SNAPSHOT_VERSION:
         raise ValueError(f"unsupported snapshot version {doc.get('version')}")
-    topo = (apis.Topology(**doc["topology"])
-            if doc.get("topology") else None)
+    raw_topo = doc.get("topology")
+    if isinstance(raw_topo, list):
+        topo = [apis.Topology(**t) for t in raw_topo]
+    else:
+        topo = apis.Topology(**raw_topo) if raw_topo else None
     cluster = Cluster.from_objects(
         [_node(d) for d in doc["nodes"]],
         [_queue(d) for d in doc["queues"]],
@@ -142,6 +155,18 @@ def load_cluster(doc: dict) -> Cluster:
     for d in doc.get("bind_requests", []):
         br = _bind_request(d)
         cluster.bind_requests[br.pod_name] = br
+    for d in doc.get("resource_claims", []):
+        claim = apis.ResourceClaim(**d)
+        cluster.resource_claims[claim.name] = claim
+    for d in doc.get("device_classes", []):
+        dc = apis.DeviceClass(**d)
+        cluster.device_classes[dc.name] = dc
+    for d in doc.get("volume_claims", []):
+        pvc = apis.PersistentVolumeClaim(**d)
+        cluster.volume_claims[pvc.name] = pvc
+    for d in doc.get("storage_classes", []):
+        sc = apis.StorageClass(**d)
+        cluster.storage_classes[sc.name] = sc
     cluster.restarting = set(doc.get("restarting", []))
     return cluster
 

@@ -325,6 +325,9 @@ class SnapshotIndex:
     #: reads — kept so cycle results never transfer them back from the
     #: device (see framework.session._pack_commit)
     host_tables: dict = dataclasses.field(default_factory=dict)
+    #: pod name → its ResourceClaim names (only pods that declare any) —
+    #: the commit path records them on BindRequests
+    claims_by_pod: dict = dataclasses.field(default_factory=dict)
     #: feasibility spans the whole node axis: no selectors, filter
     #: classes, anti-affinity, or topology constraints in the snapshot
     dense_feasibility: bool = False
@@ -363,6 +366,10 @@ def build_snapshot(
     dtype=jnp.float32,
     now: float | None = None,
     queue_usage: dict[str, "np.ndarray"] | None = None,
+    resource_claims: dict[str, apis.ResourceClaim] | None = None,
+    device_classes: dict[str, apis.DeviceClass] | None = None,
+    volume_claims: dict[str, apis.PersistentVolumeClaim] | None = None,
+    storage_classes: dict[str, apis.StorageClass] | None = None,
 ) -> tuple[ClusterState, SnapshotIndex]:
     """Flatten API objects into a ClusterState (+ index for the commit path).
 
@@ -381,7 +388,32 @@ def build_snapshot(
     def value_id(key: str, value: str) -> int:
         return label_vocab.setdefault((key, value), len(label_vocab))
 
-    topo_levels = list(topology.levels) if topology else []
+    # multiple Topology CRDs (ref topology_plugin.go building one domain
+    # tree PER Topology object): each tree's levels occupy a distinct
+    # slice of the level axis; domain ids stay globally dense, and a
+    # gang's TopologyConstraint resolves level names inside ITS named
+    # tree
+    if topology is None:
+        topos: list[apis.Topology] = []
+    elif isinstance(topology, apis.Topology):
+        topos = [topology]
+    else:
+        topos = list(topology)
+    topo_levels = [lvl for t in topos for lvl in t.levels]
+    topo_slices: dict[str, tuple[int, list[str]]] = {}
+    _off = 0
+    for t in topos:
+        topo_slices[t.name] = (_off, list(t.levels))
+        _off += len(t.levels)
+
+    def resolve_level(tc: "apis.TopologyConstraint | None",
+                      attr: str) -> int:
+        if tc is None or not topo_levels:
+            return -1
+        start, lvls = topo_slices.get(tc.topology, (0, topo_levels))
+        name = getattr(tc, attr)
+        return start + lvls.index(name) if name in lvls else -1
+
     L = max(1, len(topo_levels))
     K = max(1, len(selector_keys))
 
@@ -432,14 +464,19 @@ def build_snapshot(
                 node_labels[i, ki] = value_id(key, n.labels[key])
         # Topology domains: id per level = dense index of the label-path
         # prefix at that level, so equal ids <=> same physical domain
-        # (ref plugins/topology/topology_structs.go DomainID = joined path).
-        path: list[str] = []
-        for li, level_key in enumerate(topo_levels):
-            val = n.labels.get(level_key)
-            if val is None:
-                break
-            path.append(val)
-            node_topo[i, li] = domain_vocab.setdefault((li, "/".join(path)), len(domain_vocab))
+        # (ref plugins/topology/topology_structs.go DomainID = joined
+        # path); the path prefix resets per Topology tree
+        off = 0
+        for t in topos:
+            path: list[str] = []
+            for lj, level_key in enumerate(t.levels):
+                val = n.labels.get(level_key)
+                if val is None:
+                    break
+                path.append(val)
+                node_topo[i, off + lj] = domain_vocab.setdefault(
+                    (off + lj, "/".join(path)), len(domain_vocab))
+            off += len(t.levels)
 
     # --- queues (parents before children) --------------------------------
     queue_names = [q.name for q in queues]
@@ -584,12 +621,55 @@ def build_snapshot(
     spec_pods: dict[tuple, apis.Pod] = {
         node_filters.EMPTY_SPEC: apis.Pod("", "")}
 
-    def filter_class_of(pod: apis.Pod) -> int:
+    def dra_of(pod: apis.Pod) -> tuple[int, tuple]:
+        """(device count, resolved DeviceClass constraint key) — real
+        ResourceClaim objects drive the count and the node constraints
+        (ref dynamicresources.go claim→deviceclass selection); bare
+        ``dra_accel_count`` keeps the legacy unconstrained behavior."""
+        if not pod.resource_claims or not resource_claims:
+            return pod.dra_accel_count, ()
+        cnt, min_mem = 0, 0.0
+        sels: list[tuple[str, str]] = []
+        for cname in pod.resource_claims:
+            claim = resource_claims.get(cname)
+            if claim is None:
+                continue
+            cnt += claim.count
+            dc = (device_classes or {}).get(claim.device_class)
+            if dc is not None:
+                min_mem = max(min_mem, dc.min_memory_gib)
+                sels.extend(sorted(dc.node_selector.items()))
+        key = (min_mem, tuple(sels)) if (min_mem or sels) else ()
+        return cnt, key
+
+    def vol_of(pod: apis.Pod) -> tuple:
+        """Resolved VolumeBinding label constraints: a BOUND claim pins
+        to its volume's topology; an unbound WaitForFirstConsumer claim
+        restricts to its class's allowedTopologies (the volume binds at
+        PreBind) — ref the VolumeBinding predicate in
+        ``k8s_internal/predicates/predicates.go:70-140``."""
+        if not pod.volume_claims or not volume_claims:
+            return ()
+        items: list[tuple[str, str]] = []
+        for vname in pod.volume_claims:
+            pvc = volume_claims.get(vname)
+            if pvc is None:
+                continue
+            if pvc.bound:
+                items.extend(sorted(pvc.node_affinity.items()))
+            else:
+                sc = (storage_classes or {}).get(pvc.storage_class)
+                if sc is not None:
+                    items.extend(sorted(sc.allowed_topology.items()))
+        return tuple(items)
+
+    def filter_class_of(pod: apis.Pod, dra_key: tuple = ()) -> int:
         # fast path: the overwhelming majority of pods carry no filter
         # spec at all — class 0 without building the canonical key
-        if not (pod.tolerations or pod.node_affinity or pod.pod_affinity):
+        if not (pod.tolerations or pod.node_affinity or pod.pod_affinity
+                or dra_key or pod.volume_claims or pod.host_ports):
             return 0
-        key = node_filters.pod_filter_spec(pod)
+        key = node_filters.pod_filter_spec(pod, dra_key, vol_of(pod))
         if key not in spec_index:
             spec_index[key] = len(filter_specs)
             filter_specs.append(key)
@@ -614,20 +694,15 @@ def build_snapshot(
             sub_slot[i][sg.name] = si
             gk["subgroup_valid"][i, si] = True
             gk["subgroup_min_member"][i, si] = sg.min_member
-            tc_sg = sg.topology_constraint
-            if (tc_sg is not None and topology is not None
-                    and tc_sg.required_level in topo_levels):
-                gk["subgroup_required_level"][i, si] = \
-                    topo_levels.index(tc_sg.required_level)
+            gk["subgroup_required_level"][i, si] = resolve_level(
+                sg.topology_constraint, "required_level")
         gk["subgroup_valid"][i, 0] = True
         gk["subgroup_min_member"][i, 0] = \
             0 if g.sub_groups else g.min_member
-        tc = g.topology_constraint
-        if tc is not None and topology is not None:
-            if tc.required_level in topo_levels:
-                gk["required_level"][i] = topo_levels.index(tc.required_level)
-            if tc.preferred_level in topo_levels:
-                gk["preferred_level"][i] = topo_levels.index(tc.preferred_level)
+        gk["required_level"][i] = resolve_level(
+            g.topology_constraint, "required_level")
+        gk["preferred_level"][i] = resolve_level(
+            g.topology_constraint, "preferred_level")
         # a gang-level required topology level is enforced through the
         # subgroup machinery: subgroups without their own constraint
         # (incl. the default slot 0) inherit it, so every task locks into
@@ -676,12 +751,13 @@ def build_snapshot(
         # distinct task specs: one dict probe per pod, everything heavier
         # once per distinct type
         def _tkey(p: apis.Pod) -> tuple:
+            dra_cnt, dra_key = dra_of(p)
             return (
                 p.resources.as_tuple(),
                 tuple(sorted(p.node_selector.items()))
                 if p.node_selector else (),
-                p.accel_portion, p.accel_memory_gib, p.dra_accel_count,
-                filter_class_of(p),
+                p.accel_portion, p.accel_memory_gib, dra_cnt,
+                filter_class_of(p, dra_key),
                 tuple(sorted(p.extended.items())) if p.extended else ())
 
         tid = np.fromiter(
@@ -811,7 +887,8 @@ def build_snapshot(
         rk["valid"][:Mu] = True
         rk["releasing"][:Mu] = r_rel
         rk["filter_class"][:Mu] = np.fromiter(
-            (filter_class_of(p) for p in running_pods), np.int32, Mu)
+            (filter_class_of(p, dra_of(p)[1]) for p in running_pods),
+            np.int32, Mu)
         # group-derived fields via per-group tables + one gather
         ng = len(pod_groups)
         pg_queue = np.fromiter(
@@ -947,6 +1024,20 @@ def build_snapshot(
                     mask |= 1 << int(d0)
                 rk["devices_mask"][j] = mask
                 rk["accel_held"][j] = float(len(devs))
+    # --- allocated DRA claims hold concrete devices (ref
+    # populateDRAGPUs): debit the device table and node accel pool —
+    # running claim-holders' own req rows do NOT include the claimed
+    # devices, so this is the single accounting point -----------------
+    claim_used = np.zeros((N, R), np.float32)
+    for claim in (resource_claims or {}).values():
+        ni = node_idx.get(claim.node) if claim.node else None
+        if ni is None:
+            continue
+        for d0 in claim.devices:
+            if d0 < D:
+                taken = min(1.0, float(dev_free[ni, d0]))
+                dev_free[ni, d0] -= taken
+                claim_used[ni, 0] += taken
     for i, grp_obj in enumerate(pod_groups):
         if grp_obj.stale_since is not None:
             gk["stale_s"][i] = max(0.0, now - grp_obj.stale_since)
@@ -1001,7 +1092,8 @@ def build_snapshot(
     # unknown nodes count for queues, not for node capacity
     np.add.at(node_rel, rk["node"][rel_m], rk["req"][rel_m])
     np.add.at(node_used, rk["node"][used_m], rk["req"][used_m])
-    node_free = np.maximum(node_alloc - node_used - node_rel, 0.0)
+    node_free = np.maximum(
+        node_alloc - node_used - node_rel - claim_used, 0.0)
 
     # --- derived queue allocated / request (host mirror of
     #     queuecontroller status; kernels recompute on-device when needed) --
@@ -1035,7 +1127,8 @@ def build_snapshot(
     # --- evaluate filter classes against nodes (host, once per spec) ------
     running_views = [
         node_filters._RunningPodView(labels=pod.labels,
-                                     node=int(rk["node"][j]))
+                                     node=int(rk["node"][j]),
+                                     host_ports=tuple(pod.host_ports))
         for j, pod in enumerate(running_pods)
         if pod.status != apis.PodStatus.RELEASING]
     filter_masks, soft_scores = node_filters.evaluate_filter_classes(
@@ -1130,6 +1223,8 @@ def build_snapshot(
         has_extended_resources=bool(ext_keys),
         extended_keys=ext_keys,
         has_reclaim_minruntime=bool((q_reclaim_mrt > 0).any()),
+        claims_by_pod={p.name: list(p.resource_claims)
+                       for p in all_pend if p.resource_claims},
         host_tables={
             "task_portion": gk["task_portion"],
             "task_accel_mem": gk["task_accel_mem"],

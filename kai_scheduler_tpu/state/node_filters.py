@@ -24,6 +24,19 @@ accelerator.
 Class 0 is always the empty spec (no tolerations, no affinity): its mask
 still excludes nodes with untolerated hard taints, which is what keeps
 plain pods off control-plane/maintenance nodes.
+
+KNOWN STALENESS WINDOW (one cycle): required inter-pod (anti-)affinity
+and NodePorts are evaluated against RUNNING pods at snapshot build.
+Pods placed earlier in the SAME cycle are not reflected, so two gangs
+whose pods carry a required anti-affinity term matching each other's
+labels (or the same host port) can both bind into one domain within a
+single cycle; the reference evaluates InterPodAffinity against
+virtually-allocated session state and would serialize them.  Gang-
+INTERNAL spread is exact (the anti-self machinery runs in-kernel).  The
+conflict converges next cycle — the second gang's pods then see the
+first's as running — and is bounded by one cycle's placements; fully
+closing it needs per-(class, domain) occupancy tracking in the
+wavefront's accept step.
 """
 from __future__ import annotations
 
@@ -40,8 +53,18 @@ _W_K8S = 100_000.0
 _HARD_EFFECTS = ("NoSchedule", "NoExecute")
 
 
-def pod_filter_spec(pod: apis.Pod) -> tuple:
-    """Canonical hashable key of a pod's node-filter spec."""
+def pod_filter_spec(pod: apis.Pod, dra: tuple = (),
+                    volume: tuple = ()) -> tuple:
+    """Canonical hashable key of a pod's node-filter spec.
+
+    ``dra`` carries the pod's resolved DeviceClass constraints —
+    ``(min_memory_gib, ((label, value), ...))`` — and ``volume`` its
+    resolved VolumeBinding label constraints (bound-PVC node affinity ∪
+    unbound classes' allowedTopologies), so DRA and storage node
+    selection (ref ``plugins/dynamicresources`` and the VolumeBinding
+    predicate) ride the same vocabulary.  ``host_ports`` feed the
+    NodePorts predicate.
+    """
     aff = tuple(sorted(
         (e.key, e.operator, tuple(e.values)) for e in pod.node_affinity))
     tol = tuple(sorted(
@@ -50,18 +73,20 @@ def pod_filter_spec(pod: apis.Pod) -> tuple:
     pa = tuple(sorted(
         (term.match_labels, term.topology_key, term.anti, term.required)
         for term in pod.pod_affinity))
-    return (aff, tol, pa)
+    return (aff, tol, pa, dra, volume, tuple(sorted(pod.host_ports)))
 
 
-EMPTY_SPEC = ((), (), ())
+EMPTY_SPEC = ((), (), (), (), (), ())
 
 
 @dataclasses.dataclass
 class _RunningPodView:
-    """What pod-affinity terms need to know about existing pods."""
+    """What pod-affinity / NodePorts terms need to know about existing
+    pods."""
 
     labels: dict[str, str]
     node: int  # snapshot node index, -1 unknown
+    host_ports: tuple = ()
 
 
 def _domain_ids(node_topo: np.ndarray, topo_levels: list[str],
@@ -91,6 +116,11 @@ def evaluate_filter_classes(
     N = len(live_nodes)
     masks = np.zeros((X, num_nodes_padded), bool)
     soft = np.zeros((X, num_nodes_padded), np.float32)
+    # host-port occupancy per node (NodePorts input), built once
+    used_ports: dict[int, set] = {}
+    for rp in running:
+        if rp.node >= 0 and rp.host_ports:
+            used_ports.setdefault(rp.node, set()).update(rp.host_ports)
 
     for xi, spec in enumerate(specs):
         pod = pods_by_spec[spec]
@@ -111,6 +141,33 @@ def evaluate_filter_classes(
             for ni, node in enumerate(live_nodes):
                 if mask[ni] and not all(
                         e.matches(node.labels) for e in pod.node_affinity):
+                    mask[ni] = False
+        # --- DRA DeviceClass constraints (plugins/dynamicresources) ------
+        if len(spec) > 3 and spec[3]:
+            min_mem, sel_items = spec[3]
+            for ni, node in enumerate(live_nodes):
+                if not mask[ni]:
+                    continue
+                if min_mem > 0 and node.accel_memory_gib < min_mem:
+                    mask[ni] = False
+                elif any(node.labels.get(k) != v for k, v in sel_items):
+                    mask[ni] = False
+        # --- VolumeBinding: bound-PVC affinity / class topology ----------
+        # the hostname key falls back to the node NAME, so volumes the
+        # binder pinned per-node stay reachable on unlabeled nodes
+        if len(spec) > 4 and spec[4]:
+            for ni, node in enumerate(live_nodes):
+                if mask[ni] and any(
+                        node.labels.get(k, node.name
+                                        if k == "kubernetes.io/hostname"
+                                        else None) != v
+                        for k, v in spec[4]):
+                    mask[ni] = False
+        # --- NodePorts: requested host ports must be free on the node ---
+        if len(spec) > 5 and spec[5]:
+            want = set(spec[5])
+            for ni in range(N):
+                if mask[ni] and want & used_ports.get(ni, set()):
                     mask[ni] = False
         # --- inter-pod (anti-)affinity (upstream InterPodAffinity) -------
         pref_aff = np.zeros((N,), np.float32)

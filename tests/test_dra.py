@@ -1,0 +1,170 @@
+"""Real DRA: ResourceClaim / DeviceClass objects through schedule + bind.
+
+Mirrors the reference's ``dra_fake`` test suites
+(``pkg/scheduler/test_utils/dra_fake``,
+``plugins/dynamicresources/dynamicresources.go:30-70``,
+``bindrequest_types.go`` ResourceClaimAllocations) and the binder's
+claim binding/rollback.
+"""
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.binder.binder import Binder
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.runtime import snapshot
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+
+def _dra_cluster(num_nodes=4, big_nodes=2):
+    """Nodes 0..big-1 have 80GiB devices + the matching label; the rest
+    16GiB."""
+    nodes = []
+    for i in range(num_nodes):
+        big = i < big_nodes
+        nodes.append(apis.Node(
+            name=f"node-{i}",
+            allocatable=apis.ResourceVec(4.0, 32.0, 128.0),
+            accel_memory_gib=80.0 if big else 16.0,
+            labels={"accel": "a100" if big else "t4"},
+        ))
+    queues = [apis.Queue(name="dept", accel=apis.QueueResource(quota=16.0)),
+              apis.Queue(name="q", parent="dept",
+                         accel=apis.QueueResource(quota=16.0))]
+    cluster = Cluster.from_objects(nodes, queues, [], [])
+    cluster.device_classes["big-gpu"] = apis.DeviceClass(
+        name="big-gpu", min_memory_gib=40.0, node_selector={"accel": "a100"})
+    cluster.device_classes["any-gpu"] = apis.DeviceClass(name="any-gpu")
+    return cluster
+
+
+def _claim_pod(cluster, name, claim_name, device_class, count=2):
+    cluster.resource_claims[claim_name] = apis.ResourceClaim(
+        name=claim_name, device_class=device_class, count=count)
+    group = apis.PodGroup(name=f"{name}-pg", queue="q", min_member=1)
+    pod = apis.Pod(name=name, group=group.name,
+                   resources=apis.ResourceVec(0.0, 1.0, 1.0),
+                   resource_claims=[claim_name])
+    cluster.submit(group, [pod])
+    return pod
+
+
+def test_claim_constraints_steer_placement():
+    """A claim's DeviceClass (min memory + node selector) confines the
+    pod to matching nodes — the scheduler-side CEL analogue."""
+    cluster = _dra_cluster()
+    _claim_pod(cluster, "p-big", "claim-big", "big-gpu", count=2)
+    res = Scheduler().run_once(cluster)
+    assert len(res.bind_requests) == 1
+    br = res.bind_requests[0]
+    assert br.selected_node in ("node-0", "node-1")      # a100 nodes only
+    assert br.resource_claim_allocations == ["claim-big"]
+
+
+def test_binder_allocates_and_records_devices():
+    cluster = _dra_cluster()
+    _claim_pod(cluster, "p1", "c1", "any-gpu", count=2)
+    Scheduler().run_once(cluster)
+    result = Binder().reconcile(cluster)
+    assert result.bound == ["p1"]
+    claim = cluster.resource_claims["c1"]
+    assert claim.node is not None and len(claim.devices) == 2
+    assert claim.owner_pod == "p1"
+    # claimed devices are not free for anyone else
+    free = cluster.node_device_free(claim.node)
+    assert all(free[d] == 0.0 for d in claim.devices)
+
+
+def test_claim_devices_excluded_from_next_snapshot():
+    """Bound claims debit the device table: a follow-up whole-device pod
+    cannot double-book the claimed devices."""
+    cluster = _dra_cluster(num_nodes=1, big_nodes=0)     # 4 devices total
+    _claim_pod(cluster, "p1", "c1", "any-gpu", count=3)
+    Scheduler().run_once(cluster)
+    Binder().reconcile(cluster)
+    cluster.tick()
+    # 1 device left; a 2-device pod must NOT place
+    group = apis.PodGroup(name="pg2", queue="q", min_member=1)
+    cluster.submit(group, [apis.Pod(
+        name="p2", group="pg2", resources=apis.ResourceVec(2.0, 1.0, 1.0))])
+    res = Scheduler().run_once(cluster)
+    assert all(b.pod_name != "p2" for b in res.bind_requests)
+    # ... but a 1-device pod fits the remaining device
+    group3 = apis.PodGroup(name="pg3", queue="q", min_member=1)
+    cluster.submit(group3, [apis.Pod(
+        name="p3", group="pg3", resources=apis.ResourceVec(1.0, 1.0, 1.0))])
+    res3 = Scheduler().run_once(cluster)
+    assert any(b.pod_name == "p3" for b in res3.bind_requests)
+
+
+def test_bind_rollback_deallocates_claim():
+    cluster = _dra_cluster(num_nodes=1, big_nodes=1)
+    _claim_pod(cluster, "p1", "c1", "any-gpu", count=2)
+    Scheduler().run_once(cluster)
+    # sabotage: another claim grabs every device before the binder runs
+    cluster.resource_claims["thief"] = apis.ResourceClaim(
+        name="thief", device_class="any-gpu", count=4,
+        node="node-0", devices=[0, 1, 2, 3], owner_pod="elsewhere")
+    result = Binder().reconcile(cluster)
+    assert result.retrying == ["p1"]
+    claim = cluster.resource_claims["c1"]
+    assert claim.node is None and claim.devices == [] \
+        and claim.owner_pod is None
+
+
+def test_claims_release_on_pod_deletion():
+    cluster = _dra_cluster(num_nodes=1, big_nodes=0)
+    pod = _claim_pod(cluster, "p1", "c1", "any-gpu", count=2)
+    Scheduler().run_once(cluster)
+    Binder().reconcile(cluster)
+    cluster.tick()
+    assert cluster.resource_claims["c1"].node == "node-0"
+    cluster.evict_pod("p1")
+    cluster.tick()
+    assert cluster.resource_claims["c1"].node is None
+    assert pod.name not in cluster.pods
+
+
+def test_dra_snapshot_roundtrip():
+    cluster = _dra_cluster()
+    _claim_pod(cluster, "p1", "c1", "big-gpu", count=1)
+    doc = snapshot.dump_cluster(cluster)
+    back = snapshot.load_cluster(doc)
+    assert back.resource_claims["c1"].device_class == "big-gpu"
+    assert back.device_classes["big-gpu"].min_memory_gib == 40.0
+    res = Scheduler().run_once(back)
+    assert res.bind_requests[0].resource_claim_allocations == ["c1"]
+
+
+def test_mig_gang_reclaims_mig_victim():
+    """MIG credit-back (VERDICT r2 item 6): the ONLY path to placing a
+    MIG gang is evicting the MIG-holding victim — the freed extended
+    resources must flow back into the scenario pools."""
+    nodes = [apis.Node(name="n0",
+                       allocatable=apis.ResourceVec(4.0, 32.0, 128.0),
+                       extended={"mig-1g.5gb": 2.0})]
+    queues = [
+        apis.Queue(name="d0", accel=apis.QueueResource(quota=2.0)),
+        apis.Queue(name="qv", parent="d0",
+                   accel=apis.QueueResource(quota=0.0)),
+        apis.Queue(name="qr", parent="d0",
+                   accel=apis.QueueResource(quota=2.0)),
+    ]
+    victim_pg = apis.PodGroup(name="vg", queue="qv", min_member=1,
+                              last_start_timestamp=0.0)
+    victim = apis.Pod(name="v0", group="vg",
+                      resources=apis.ResourceVec(0.0, 1.0, 1.0),
+                      extended={"mig-1g.5gb": 2.0},
+                      status=apis.PodStatus.RUNNING, node="n0")
+    pend_pg = apis.PodGroup(name="rg", queue="qr", min_member=1)
+    pend = apis.Pod(name="r0", group="rg",
+                    resources=apis.ResourceVec(0.0, 1.0, 1.0),
+                    extended={"mig-1g.5gb": 2.0})
+    cluster = Cluster.from_objects(
+        nodes, queues, [victim_pg, pend_pg], [victim, pend])
+    res = Scheduler().run_once(cluster)
+    assert {e.pod_name for e in res.evictions} == {"v0"}
+    placements = np.asarray(res.tensors.placements)
+    allocated = np.asarray(res.tensors.allocated)
+    # the MIG gang is placed (pipelined onto the victim's capacity)
+    assert allocated.any()
+    assert (placements >= 0).any()

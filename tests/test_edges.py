@@ -1,0 +1,147 @@
+"""Round-3 edge coverage: admission chain breadth, profiler endpoint,
+incremental cluster protocol, multiple Topology trees."""
+import json
+import urllib.request
+
+import pytest
+
+from kai_scheduler_tpu.admission.webhooks import (AdmissionChain,
+                                                  AdmissionError)
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.framework.server import SchedulerServer
+from kai_scheduler_tpu.runtime import snapshot
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.state import make_cluster
+
+
+# --- admission breadth (ref pkg/admission/webhook/v1alpha2) --------------
+
+def test_runtimeenforcement_sets_runtime_class():
+    chain = AdmissionChain()
+    pod = apis.Pod(name="p", group="g",
+                   resources=apis.ResourceVec(1.0, 1.0, 1.0))
+    chain.admit(pod)
+    assert pod.labels["kai.scheduler/runtime-class"] == "tpu-runtime"
+    cpu_pod = apis.Pod(name="c", group="g",
+                       resources=apis.ResourceVec(0.0, 1.0, 1.0))
+    chain.admit(cpu_pod)
+    assert "kai.scheduler/runtime-class" not in cpu_pod.labels
+
+
+def test_gpusharing_gate_rejects_when_disabled():
+    from kai_scheduler_tpu.admission.webhooks import GpuSharingGate
+    chain = AdmissionChain(plugins=[GpuSharingGate(sharing_enabled=False)])
+    pod = apis.Pod(name="p", group="g", accel_portion=0.5)
+    with pytest.raises(AdmissionError):
+        chain.admit(pod)
+    # whole-device pods pass the gate
+    chain.admit(apis.Pod(name="w", group="g",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0)))
+
+
+# --- server: profiler + incremental protocol ----------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_profiler_and_incremental_protocol():
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=4, node_accel=4.0, num_gangs=2, tasks_per_gang=2)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    doc = snapshot.dump_cluster(cluster)   # pristine, pre-profiler
+    server = SchedulerServer(cluster, Scheduler()).start()
+    try:
+        prof = _get(server.port, "/debug/pprof/profile")
+        assert prof["hottest"] and prof["total_seconds"] > 0
+        # upload once ...
+        assert _post(server.port, "/cluster", doc)["ok"]
+        # ... run a cycle on the stored cluster ...
+        out = _post(server.port, "/cycle/stored", {})
+        assert len(out["bind_requests"]) == 4
+        # ... then PATCH a delta (one new 1-pod group) instead of
+        # re-shipping the document
+        new_pg = {"name": "late", "queue": groups[0].queue,
+                  "min_member": 1}
+        # a PARTIAL pod document: unspecified fields (status, affinity,
+        # ...) merge from defaults
+        new_pod = {"name": "late-0", "group": "late",
+                   "resources": {"accel": 1.0, "cpu": 1.0, "memory": 1.0}}
+        assert _post(server.port, "/cluster/delta", {
+            "pod_groups_upsert": [new_pg], "pods_upsert": [new_pod],
+        })["ok"]
+        out2 = _post(server.port, "/cycle/stored", {})
+        assert any(b["pod"] == "late-0" for b in out2["bind_requests"])
+    finally:
+        server.stop()
+
+
+# --- multiple Topology CRDs ---------------------------------------------
+
+def test_two_topology_trees_resolve_independently():
+    """Two Topology objects (network racks vs power zones): each gang
+    constrains against ITS tree — ref topology_plugin.go building one
+    domain tree per Topology CRD."""
+    topo_net = apis.Topology(name="network",
+                             levels=["net/rack", "kubernetes.io/hostname"])
+    topo_pwr = apis.Topology(name="power",
+                             levels=["pwr/zone", "kubernetes.io/hostname"])
+    nodes = []
+    for i in range(4):
+        nodes.append(apis.Node(
+            name=f"n{i}", allocatable=apis.ResourceVec(4.0, 32.0, 128.0),
+            labels={"net/rack": f"r{i % 2}", "pwr/zone": f"z{i // 2}",
+                    "kubernetes.io/hostname": f"n{i}"}))
+    queues = [apis.Queue(name="dept", accel=apis.QueueResource(quota=16.0)),
+              apis.Queue(name="q", parent="dept",
+                         accel=apis.QueueResource(quota=16.0))]
+    # rack r0 = {n0, n2}; zone z0 = {n0, n1}
+    pg_net = apis.PodGroup(
+        name="g-net", queue="q", min_member=2,
+        topology_constraint=apis.TopologyConstraint(
+            topology="network", required_level="net/rack"))
+    pg_pwr = apis.PodGroup(
+        name="g-pwr", queue="q", min_member=2,
+        topology_constraint=apis.TopologyConstraint(
+            topology="power", required_level="pwr/zone"))
+    pods = [apis.Pod(name=f"{g}-{t}", group=g,
+                     resources=apis.ResourceVec(2.0, 1.0, 1.0))
+            for g in ("g-net", "g-pwr") for t in range(2)]
+    cluster = Cluster.from_objects(nodes, queues, [pg_net, pg_pwr], pods,
+                                   [topo_net, topo_pwr])
+    res = Scheduler().run_once(cluster)
+    by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+    assert len(by_pod) == 4
+    net_nodes = {by_pod["g-net-0"], by_pod["g-net-1"]}
+    pwr_nodes = {by_pod["g-pwr-0"], by_pod["g-pwr-1"]}
+    racks = {{"n0": "r0", "n1": "r1", "n2": "r0", "n3": "r1"}[n]
+             for n in net_nodes}
+    zones = {{"n0": "z0", "n1": "z0", "n2": "z1", "n3": "z1"}[n]
+             for n in pwr_nodes}
+    assert len(racks) == 1, net_nodes   # g-net in ONE network rack
+    assert len(zones) == 1, pwr_nodes   # g-pwr in ONE power zone
+
+
+def test_multi_topology_snapshot_roundtrip():
+    topo_a = apis.Topology(name="a", levels=["ra", "kubernetes.io/hostname"])
+    topo_b = apis.Topology(name="b", levels=["zb", "kubernetes.io/hostname"])
+    nodes, queues, groups, pods, _ = make_cluster(
+        num_nodes=2, node_accel=2.0, num_gangs=1, tasks_per_gang=1)
+    for i, n in enumerate(nodes):
+        n.labels.update({"ra": f"r{i}", "zb": "z0"})
+    cluster = Cluster.from_objects(nodes, queues, groups, pods,
+                                   [topo_a, topo_b])
+    back = snapshot.load_cluster(snapshot.dump_cluster(cluster))
+    assert [t.name for t in back.topology] == ["a", "b"]
+    assert len(Scheduler().run_once(back).bind_requests) == 1
