@@ -978,7 +978,24 @@ def _run_victim_action_chunked(
         bad = cand_valid & ~(ok_pre & accept)                    # [B]
         bad_cum = jnp.cumsum(bad.astype(jnp.int32))
         take = cand_valid & (bad_cum == 0)                       # [B]
-        first_fail = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
+        # Only a GATE/placement failure of the first bad lane is final —
+        # its inputs composed exactly (every earlier valid lane took).
+        # An accept failure there is a cross-lane capacity CONFLICT
+        # (e.g. two lanes binpacked onto one node): the lane retries
+        # next chunk, where, as the leading lane, its accept is
+        # self-consistent — mirroring allocate's conflict-retry.
+        #
+        # TERMINATION INVARIANT (the fuel bound below relies on it):
+        # every chunk must retire >=1 lane, which holds because a
+        # LEADING valid lane's accept is implied by ok_pre — each accept
+        # component (node floors vs its own extra pool, bind vs
+        # chunk-start idle, queue caps, the reclaim fair-share term) is
+        # already enforced by gate_b/_attempt_gang when no earlier lane
+        # contributed deltas.  If you add an accept-ONLY check, also
+        # gate it in gate_b (or retire the leading conflict lane), or
+        # the loop can spin identical chunks until fuel exhausts.
+        first_bad = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
+        first_fail = first_bad & ~ok_pre
         any_take = jnp.any(take)
         k_star = jnp.max(jnp.where(take, k_b, -1))
         star = jnp.argmax(jnp.where(take, k_b, -1))
