@@ -138,7 +138,15 @@ class Session:
                 allocate=dataclasses.replace(
                     config.allocate, track_devices=devices,
                     uniform_tasks=uniform, subgroup_topology=sub_topo,
-                    extended=ext, dense_feasibility=dense),
+                    extended=ext, dense_feasibility=dense,
+                    anti_groups=index.has_anti_groups,
+                    # 0 when disabled (the count is behaviorally dead
+                    # then), padded to a power of two when enabled —
+                    # AllocateConfig is a STATIC jit arg, so every
+                    # distinct value is a fresh XLA compile
+                    num_anti_groups=(
+                        1 << max(0, index.num_anti_groups - 1)
+                        .bit_length() if index.has_anti_groups else 0)),
                 victims=dataclasses.replace(
                     config.victims,
                     chunk_reclaim=not index.has_reclaim_minruntime,

@@ -240,6 +240,14 @@ class AllocateConfig:
     #: skip gangs whose scheduling signature already failed this action —
     #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
     signature_skip: bool = True
+    #: track cross-gang required anti-affinity domains IN-CYCLE: gangs
+    #: sharing an anti group (mutual required anti terms) may not land
+    #: in one domain within a single allocate action (ref
+    #: InterPodAffinity over virtually-allocated session state).  The
+    #: Session enables this only when the snapshot holds >=2 gangs in
+    #: one group; ``num_anti_groups`` sizes the tracking table.
+    anti_groups: bool = False
+    num_anti_groups: int = 0
 
 
 def _attempt_gang_in_domain(
@@ -875,7 +883,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   quota: jax.Array | None = None,
                   ext_free: jax.Array | None = None,
                   extra_extended_releasing: jax.Array | None = None,
-                  topo_tables=None):
+                  topo_tables=None,
+                  domain_mask: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -909,11 +918,13 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     else:
         in_domain = _attempt_gang_in_domain
 
+    dmask = n.valid if domain_mask is None else (n.valid & domain_mask)
+
     def run(banned):
         extras = ((topo_tables,) if config.uniform_tasks else ())
         return in_domain(
             state, gang_idx, free, device_free, q_alloc, q_alloc_np,
-            num_levels, config, n.valid, pref_doms, has_pref,
+            num_levels, config, dmask, pref_doms, has_pref,
             extra_releasing, extra_device_releasing, lane, chain,
             prior_nodes, quota, ext_free, extra_extended_releasing,
             banned, *extras)
@@ -1054,21 +1065,42 @@ def allocate(
             jnp.where(level_of_dom >= 0, agg[:ND], jnp.inf))
         return dom_caps_y, level_of_dom, order_by_agg
 
-    def attempt_one(gi, lane, prior, quota, free, dev, qa, qan, ext,
-                    topo_tables):
+    # cross-gang anti-affinity tracking (config.anti_groups): dense
+    # domain id per (node, level) with per-node slots appended for the
+    # hostname granularity; AD+1 = junk slot
+    AD = ND + n.n
+    AGP = max(1, config.num_anti_groups)
+    if config.anti_groups:
+        node_slot = ND + jnp.arange(n.n)
+
+        def lane_dom_ids(lvl):
+            """[N] dense domain id at this gang's anti level.  Nodes
+            LACKING the level's label are their own per-node domain
+            (upstream anti-affinity treats a missing topology key as
+            no shared domain → no conflict); only padded node slots
+            map to the junk id AD."""
+            by_level = n.topology[:, jnp.clip(lvl, 0, L - 1)]
+            ids = jnp.where((lvl >= 0) & (lvl < L),
+                            jnp.where(by_level >= 0, by_level, node_slot),
+                            jnp.where(lvl >= L, node_slot, AD))
+            return jnp.where(n.valid, ids, AD)
+
+    def attempt_one(gi, lane, prior, quota, dmask, free, dev, qa, qan,
+                    ext, topo_tables):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
                              config, extra, extra_dev, lane, chain,
                              prior_nodes=prior, quota=quota, ext_free=ext,
                              extra_extended_releasing=init.
                              extended_releasing_extra,
-                             topo_tables=topo_tables)
+                             topo_tables=topo_tables,
+                             domain_mask=dmask)
 
     def cond(carry):
-        res, remaining, q_attempts, failed_sig, fuel = carry
-        return jnp.any(remaining) & (fuel > 0)
+        return jnp.any(carry[1]) & (carry[4] > 0)
 
     def chunk(carry):
-        res, remaining, q_attempts, failed_sig, fuel = carry
+        res, remaining, q_attempts, failed_sig, fuel = carry[:5]
+        anti_used = carry[5] if config.anti_groups else None
         free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
                               res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
@@ -1123,14 +1155,36 @@ def allocate(
         lanes = jnp.arange(B, dtype=jnp.int32)
         ext = res.extended_free
         tables = topo_tables_for(free, dev, qa) if hoist_topo else None
+        if config.anti_groups:
+            # lanes of an anti group may not use domains the group
+            # already claimed in earlier chunks...
+            ag_b = g.anti_group[cand]                             # [B]
+            lvl_b = g.anti_self_level[cand]
+            dom_ids_b = jax.vmap(lane_dom_ids)(lvl_b)             # [B, N]
+            forbid_b = (ag_b >= 0)[:, None] & anti_used[
+                jnp.maximum(ag_b, 0), dom_ids_b]
+            dmask_b = ~forbid_b                                   # [B, N]
+            # ... and only ONE lane per group may land per chunk (the
+            # rest conflict-retry with the updated table)
+            same = ((ag_b[None, :] == ag_b[:, None])
+                    & (ag_b >= 0)[None, :]
+                    & (jnp.arange(B)[None, :] < jnp.arange(B)[:, None]))
+            dup_b = jnp.any(same & cand_valid[None, :], axis=1) \
+                & cand_valid
+        else:
+            dmask_b = jnp.ones((B, n.n), bool)
+            dup_b = jnp.zeros((B,), bool)
         (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
          bind_b, devbind_b, ext2_b, extbind_b) = \
             jax.vmap(attempt_one,
-                     in_axes=(0, 0, 0, 0, None, None, None, None, None,
-                              None))(
-                cand, lanes, prior_b, quota_b, free, dev, qa, qan, ext,
-                tables)
-        succ_b = succ_b & cand_valid
+                     in_axes=(0, 0, 0, 0, 0, None, None, None, None,
+                              None, None))(
+                cand, lanes, prior_b, quota_b, dmask_b, free, dev, qa,
+                qan, ext, tables)
+        # a same-group duplicate lane is CONFLICT-rejected (retries next
+        # chunk), never counted as a genuine fit failure
+        succ_all = succ_b & cand_valid
+        succ_b = succ_all & ~dup_b
 
         ok = succ_b[:, None, None]
         d_free = jnp.where(ok, free - free2_b, 0.0)               # [B, N, R]
@@ -1205,9 +1259,11 @@ def allocate(
         # done: the gang is whole (take, nothing left to scale up), or the
         # attempt failed (failure is final — capacity only shrinks).
         # Successful partial gangs re-enter the heap (re-push); conflict-
-        # rejected successes retry next chunk.
-        done_b = cand_valid & ((take & (total_cnt >= valid_cnt)) | ~succ_b)
-        fail_b = cand_valid & ~succ_b
+        # rejected successes (incl. same-anti-group duplicates, whose
+        # succ_b was cleared above) retry next chunk.
+        done_b = cand_valid & ((take & (total_cnt >= valid_cnt))
+                               | ~(succ_b | dup_b))
+        fail_b = cand_valid & ~(succ_b | dup_b)
         # a scale-up failure of an already-quorate gang is not a fit
         # failure of the gang (its quorum stands)
         fail_fresh = fail_b & (placed_cnt == 0)
@@ -1252,18 +1308,33 @@ def allocate(
             res = res.replace(
                 fit_reason=jnp.where(skip_now, 2, res.fit_reason))
             remaining = remaining & ~skip_now
-        return res, remaining, q_attempts, failed_sig, fuel - 1
+        out = (res, remaining, q_attempts, failed_sig, fuel - 1)
+        if config.anti_groups:
+            # taken lanes claim their placements' domains for the group;
+            # unmarked slots scatter into the JUNK ROW (index AGP) —
+            # never into a real group's row at the junk column, which
+            # doubles as a real per-node id for unlabeled nodes
+            mark = (take & (ag_b >= 0))[:, None] & (nodes_b >= 0)  # [B, T]
+            doms_t = jnp.take_along_axis(
+                dom_ids_b, jnp.maximum(nodes_b, 0), axis=1)        # [B, T]
+            rows = jnp.where(mark, jnp.maximum(ag_b, 0)[:, None], AGP)
+            anti_used = anti_used.at[
+                rows, jnp.where(mark, doms_t, AD)].max(True)
+            out = out + (anti_used,)
+        return out
 
     # fuel: every chunk either retires ≥1 remaining gang (the first
     # remaining gang in order always lands in the accept prefix, or its
     # exhausted queue drains from `remaining`) or places ≥1 new task of a
     # re-pushed gang, so G*(T+1) chunks is a hard upper bound; the common
     # case is ceil(G/B) + elastic re-pushes + a few conflicts.
-    res, _, _, _, _ = lax.while_loop(
-        cond, chunk,
-        (init, remaining0, jnp.zeros((q.q,), jnp.int32),
-         jnp.zeros((G,), bool), jnp.asarray(G * (T + 1), jnp.int32)))
-    return res
+    carry0 = (init, remaining0, jnp.zeros((q.q,), jnp.int32),
+              jnp.zeros((G,), bool), jnp.asarray(G * (T + 1), jnp.int32))
+    if config.anti_groups:
+        # row AGP is the junk write row (see the commit scatter)
+        carry0 = carry0 + (jnp.zeros((AGP + 1, AD + 1), bool),)
+    out = lax.while_loop(cond, chunk, carry0)
+    return out[0]
 
 
 @functools.partial(jax.jit, static_argnames=("num_levels", "config"))

@@ -205,6 +205,11 @@ class GangState(struct.PyTreeNode):
     #: gang-internal anti-affinity: tasks of this gang may not share a
     #: topology domain at this level (L = per-node, -1 = none)
     anti_self_level: jax.Array    # i32 [G]
+    #: cross-gang anti group: gangs sharing an id carry the SAME
+    #: required anti term matching each other's pods — no two of their
+    #: pods may share a domain at anti_self_level, across gangs (-1 =
+    #: none); see the allocate wavefront's anti-domain tracking
+    anti_group: jax.Array         # i32 [G]
 
     @property
     def g(self) -> int:
@@ -321,6 +326,11 @@ class SnapshotIndex:
     #: reclaimer) LCA tables are lane-dependent, so the chunked victim
     #: path must stay off (see VictimConfig.chunk_reclaim)
     has_reclaim_minruntime: bool = False
+    #: >=2 pending gangs share a cross-gang anti group (mutual required
+    #: anti-affinity): the allocate wavefront tracks their claimed
+    #: domains in-cycle (AllocateConfig.anti_groups)
+    has_anti_groups: bool = False
+    num_anti_groups: int = 0
     #: host (numpy) copies of the snapshot-side tables the commit path
     #: reads — kept so cycle results never transfer them back from the
     #: device (see framework.session._pack_commit)
@@ -600,6 +610,7 @@ def build_snapshot(
         task_filter_class=np.zeros((G, T), np.int32),
         task_nominated=np.full((G, T), -1, np.int32),
         anti_self_level=np.full((G,), -1, np.int32),
+        anti_group=np.full((G,), -1, np.int32),
         task_type=np.zeros((G, T), np.int32),
         sig=np.zeros((G,), np.int32),
         task_extended=np.zeros((G, T, E), np.float32),
@@ -821,11 +832,23 @@ def build_snapshot(
             gk["task_subgroup"][gi_a, ti_a] = subcol[order]
         paff = np.fromiter((bool(p.pod_affinity) for p in all_pend), bool,
                            nf)
+        anti_vocab: dict[tuple, int] = {}
+        gang_anti_key: dict[int, tuple] = {}
         for j in np.nonzero(paff)[0].tolist():
-            asl = node_filters.anti_self_level(all_pend[j], topo_levels, L)
+            asl, akey = node_filters.anti_self_term(all_pend[j],
+                                                    topo_levels, L)
             if asl >= 0:
                 i = gidx[j]
                 cur = gk["anti_self_level"][i]
+                # the group id must track the WINNING (coarsest) level —
+                # its dense domain-id space is level-specific, so a
+                # mismatched (group, level) pair would never collide
+                # with its peers' marks
+                if cur < 0 or asl < cur or (asl == cur
+                                            and akey < gang_anti_key[i]):
+                    gang_anti_key[i] = akey
+                    gk["anti_group"][i] = anti_vocab.setdefault(
+                        akey, len(anti_vocab))
                 gk["anti_self_level"][i] = (asl if cur < 0
                                             else min(cur, asl))
 
@@ -1223,6 +1246,10 @@ def build_snapshot(
         has_extended_resources=bool(ext_keys),
         extended_keys=ext_keys,
         has_reclaim_minruntime=bool((q_reclaim_mrt > 0).any()),
+        has_anti_groups=bool(
+            len(np.unique(gk["anti_group"][gk["anti_group"] >= 0]))
+            < (gk["anti_group"] >= 0).sum()),
+        num_anti_groups=int(gk["anti_group"].max(initial=-1)) + 1,
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

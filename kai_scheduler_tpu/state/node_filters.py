@@ -25,18 +25,20 @@ Class 0 is always the empty spec (no tolerations, no affinity): its mask
 still excludes nodes with untolerated hard taints, which is what keeps
 plain pods off control-plane/maintenance nodes.
 
-KNOWN STALENESS WINDOW (one cycle): required inter-pod (anti-)affinity
-and NodePorts are evaluated against RUNNING pods at snapshot build.
-Pods placed earlier in the SAME cycle are not reflected, so two gangs
-whose pods carry a required anti-affinity term matching each other's
-labels (or the same host port) can both bind into one domain within a
-single cycle; the reference evaluates InterPodAffinity against
-virtually-allocated session state and would serialize them.  Gang-
-INTERNAL spread is exact (the anti-self machinery runs in-kernel).  The
-conflict converges next cycle — the second gang's pods then see the
-first's as running — and is bounded by one cycle's placements; fully
-closing it needs per-(class, domain) occupancy tracking in the
-wavefront's accept step.
+IN-CYCLE AFFINITY SEMANTICS: required (anti-)affinity vs RUNNING pods
+is evaluated here at snapshot build.  MUTUAL required anti-affinity
+between gangs (both sides' terms select each other's labels — the
+"one db per node/rack" pattern) is ALSO enforced within a cycle: such
+gangs share an anti GROUP (``GangState.anti_group``) and the allocate
+wavefront tracks the domains each group has claimed, so two of them
+cannot land in one domain even in the same chunk (see
+``AllocateConfig.anti_groups``).  What remains snapshot-stale for one
+cycle: ASYMMETRIC required affinity/anti-affinity toward another gang
+placed in the same cycle, NodePorts conflicts between two pending
+pods, and preemptors placed by the VICTIM actions (reclaim/preempt
+place one gang at a time without the allocate wavefront's anti-domain
+table) — all converge next cycle when the first placement shows up as
+running.
 """
 from __future__ import annotations
 
@@ -211,7 +213,17 @@ def anti_self_level(pod: apis.Pod, topo_levels: list[str],
     level count) for per-node granularity, or -1 for none.  When several
     such terms exist the coarsest (outermost) level wins.
     """
-    best = -1
+    return anti_self_term(pod, topo_levels, num_levels)[0]
+
+
+def anti_self_term(pod: apis.Pod, topo_levels: list[str],
+                   num_levels: int) -> tuple[int, tuple]:
+    """(level, term key) of the winning self-selecting required anti
+    term — the key identifies the CROSS-GANG anti group: two gangs whose
+    pods carry the same (selector, level) term and match it mutually
+    must not share a domain, across gangs as well as within one (ref
+    InterPodAffinity over virtually-allocated session state)."""
+    best, key = -1, ()
     for term in pod.pod_affinity:
         if not (term.required and term.anti and term.selects(pod.labels)):
             continue
@@ -219,5 +231,8 @@ def anti_self_level(pod: apis.Pod, topo_levels: list[str],
             lvl = topo_levels.index(term.topology_key)
         else:
             lvl = num_levels  # per-node
-        best = lvl if best < 0 else min(best, lvl)
-    return best
+        cand = (term.match_labels, lvl)
+        # deterministic: coarsest level wins, smallest key on ties
+        if best < 0 or lvl < best or (lvl == best and cand < key):
+            best, key = lvl, cand
+    return best, key

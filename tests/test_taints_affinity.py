@@ -5,8 +5,10 @@ and the ``podaffinity`` / ``nominatednode`` plugins."""
 import numpy as np
 
 from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
 from kai_scheduler_tpu.ops import drf
 from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
+from kai_scheduler_tpu.runtime.cluster import Cluster
 from kai_scheduler_tpu.state import build_snapshot
 
 
@@ -228,3 +230,60 @@ def test_filter_class_dedup():
     # class 0 (empty) + one shared class for the two pods
     assert state.nodes.filter_masks.shape[0] == 2
     assert idx.uniform_gangs
+
+
+class TestCrossGangAntiAffinity:
+    """In-cycle cross-gang required anti-affinity (the round-2 advisor's
+    medium finding): two gangs whose pods carry a required anti term
+    matching each other's labels must NOT share a domain within one
+    cycle — the allocate wavefront tracks claimed domains per anti
+    group."""
+
+    @staticmethod
+    def _cluster(levels=None, key="kubernetes.io/hostname"):
+        topo = None
+        nodes = []
+        for i in range(4):
+            labels = {"kubernetes.io/hostname": f"n{i}"}
+            if levels:
+                labels["rack"] = f"r{i % 2}"
+            nodes.append(apis.Node(
+                name=f"n{i}",
+                allocatable=apis.ResourceVec(8.0, 64.0, 256.0),
+                labels=labels))
+        if levels:
+            topo = apis.Topology(name="default",
+                                 levels=["rack", "kubernetes.io/hostname"])
+        queues = [
+            apis.Queue(name="dept", accel=apis.QueueResource(quota=32.0)),
+            apis.Queue(name="q", parent="dept",
+                       accel=apis.QueueResource(quota=32.0))]
+        term = apis.PodAffinityTerm(
+            match_labels=(("app", "db"),), topology_key=key,
+            anti=True, required=True)
+        groups, pods = [], []
+        for gname in ("db-a", "db-b", "db-c"):
+            groups.append(apis.PodGroup(name=gname, queue="q",
+                                        min_member=1))
+            pods.append(apis.Pod(
+                name=f"{gname}-0", group=gname,
+                resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                labels={"app": "db"}, pod_affinity=[term]))
+        return Cluster.from_objects(nodes, queues, groups, pods, topo)
+
+    def test_three_gangs_three_distinct_nodes(self):
+        cluster = self._cluster()
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 3
+        assert len(set(by_pod.values())) == 3, by_pod   # pairwise distinct
+
+    def test_rack_level_groups_use_distinct_racks(self):
+        cluster = self._cluster(levels=True, key="rack")
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        # only two racks exist: exactly two of the three gangs can place
+        # this cycle, in DIFFERENT racks; the third waits
+        racks = {int(n[1]) % 2 for n in by_pod.values()}
+        assert len(by_pod) == 2, by_pod
+        assert len(racks) == 2, by_pod
