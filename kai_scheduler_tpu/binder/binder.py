@@ -43,25 +43,37 @@ class GpuSharingPlugin:
     The reference's gpusharing binder plugin injects visible-device env
     vars resolved through a reservation pod per shared GPU group
     (``binder/binding/resourcereservation/``).  TPU-native equivalent:
-    fractional tasks are tagged with their device *group* so the runtime
-    can map them onto the same chip; no reservation round-trip is needed
-    because assignment is decided by the scheduler's device-group tensor.
+    device identity is scheduler-owned (no discovery round trip), and
+    the share group is pinned through the cluster's
+    ``ReservationRegistry`` — PreBind joins the target device's
+    reservation (creating it for the first sharer), Rollback leaves it,
+    and the registry's UUID is what the runtime mounts.
     """
 
     name: str = "gpusharing"
     _saved_portions: dict = dataclasses.field(default_factory=dict)
+    _acquired: dict = dataclasses.field(default_factory=dict)
 
     def pre_bind(self, cluster, pod, request):
         if request.received_resource_type == apis.ReceivedResourceType.FRACTION:
             self._saved_portions[pod.name] = pod.accel_portion
             pod.accel_portion = request.received_accel_portion or pod.accel_portion
+            if request.selected_accel_groups:
+                dev = request.selected_accel_groups[0]
+                cluster.reservations.acquire(
+                    request.selected_node, dev, pod.name)
+                self._acquired[pod.name] = (request.selected_node, dev)
 
     def post_bind(self, cluster, pod, request):
         self._saved_portions.pop(pod.name, None)
+        self._acquired.pop(pod.name, None)
 
     def rollback(self, cluster, pod, request):
         if pod.name in self._saved_portions:
             pod.accel_portion = self._saved_portions.pop(pod.name)
+        if pod.name in self._acquired:
+            node, dev = self._acquired.pop(pod.name)
+            cluster.reservations.release(pod.name, node, dev)
 
 
 @dataclasses.dataclass

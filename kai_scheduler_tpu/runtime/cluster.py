@@ -38,6 +38,14 @@ class Cluster:
         default_factory=dict)
     storage_classes: dict[str, apis.StorageClass] = dataclasses.field(
         default_factory=dict)
+    #: shared-device reservation registry (ref the reservation pods in
+    #: kai-resource-reservation; see runtime/reservation.py)
+    reservations: "object" = None
+
+    def __post_init__(self):
+        if self.reservations is None:
+            from .reservation import ReservationRegistry
+            self.reservations = ReservationRegistry()
     #: monotonic clock advanced by the simulation driver
     now: float = 0.0
     #: evicted pods whose workload controller will recreate them (the
@@ -209,12 +217,15 @@ class Cluster:
             pod = self.pods[name]
             if pod.status == apis.PodStatus.RELEASING:
                 # the pod's DRA claims deallocate with it (ref claim
-                # deallocation on pod deletion)
+                # deallocation on pod deletion) ...
                 for claim in self.resource_claims.values():
                     if claim.owner_pod == name:
                         claim.node = None
                         claim.devices = []
                         claim.owner_pod = None
+                # ... and its device reservations drop this sharer (the
+                # reservation pod is deleted with the last one)
+                self.reservations.release(name)
                 if name in self.restarting:
                     self.restarting.discard(name)
                     pod.status = apis.PodStatus.PENDING

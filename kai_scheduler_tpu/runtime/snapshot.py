@@ -168,6 +168,18 @@ def load_cluster(doc: dict) -> Cluster:
         sc = apis.StorageClass(**d)
         cluster.storage_classes[sc.name] = sc
     cluster.restarting = set(doc.get("restarting", []))
+    # rebuild the shared-device reservation registry from bound
+    # fractional pods — reservations are derived state (the reference
+    # reconciles reservation pods from the cluster the same way), so
+    # they are reconstructed rather than serialized
+    for pod in cluster.pods.values():
+        if (pod.node and pod.accel_devices
+                and (pod.accel_portion > 0 or pod.accel_memory_gib > 0)
+                and pod.status in (apis.PodStatus.BOUND,
+                                   apis.PodStatus.RUNNING,
+                                   apis.PodStatus.RELEASING)):
+            cluster.reservations.acquire(pod.node, pod.accel_devices[0],
+                                         pod.name)
     return cluster
 
 

@@ -28,11 +28,13 @@ plain pods off control-plane/maintenance nodes.
 IN-CYCLE AFFINITY SEMANTICS: required (anti-)affinity vs RUNNING pods
 is evaluated here at snapshot build.  MUTUAL required anti-affinity
 between gangs (both sides' terms select each other's labels — the
-"one db per node/rack" pattern) is ALSO enforced within a cycle: such
-gangs share an anti GROUP (``GangState.anti_group``) and the allocate
+"one db per node/rack" pattern) is ALSO enforced within a cycle when
+the gangs' WINNING (coarsest) self-anti terms coincide: such gangs
+share an anti GROUP (``GangState.anti_group``) and the allocate
 wavefront tracks the domains each group has claimed, so two of them
 cannot land in one domain even in the same chunk (see
-``AllocateConfig.anti_groups``).  What remains snapshot-stale for one
+``AllocateConfig.anti_groups``; one group slot per gang — pairs that
+share only a non-winning term fall back to next-cycle convergence).  What remains snapshot-stale for one
 cycle: ASYMMETRIC required affinity/anti-affinity toward another gang
 placed in the same cycle, NodePorts conflicts between two pending
 pods, and preemptors placed by the VICTIM actions (reclaim/preempt
@@ -205,24 +207,22 @@ def evaluate_filter_classes(
     return masks, soft
 
 
-def anti_self_level(pod: apis.Pod, topo_levels: list[str],
-                    num_levels: int) -> int:
-    """The gang-internal spread constraint: a required anti-affinity term
-    whose selector matches the pod's OWN labels forbids two pods of the
-    gang sharing a domain.  Returns the topology level index, ``L`` (the
-    level count) for per-node granularity, or -1 for none.  When several
-    such terms exist the coarsest (outermost) level wins.
-    """
-    return anti_self_term(pod, topo_levels, num_levels)[0]
-
-
 def anti_self_term(pod: apis.Pod, topo_levels: list[str],
                    num_levels: int) -> tuple[int, tuple]:
-    """(level, term key) of the winning self-selecting required anti
-    term — the key identifies the CROSS-GANG anti group: two gangs whose
-    pods carry the same (selector, level) term and match it mutually
-    must not share a domain, across gangs as well as within one (ref
-    InterPodAffinity over virtually-allocated session state)."""
+    """(level, term key) of the WINNING self-selecting required anti
+    term: the gang-internal spread constraint (two pods of the gang may
+    not share a domain at this level; ``num_levels`` = per-node, -1 =
+    none), and the key that identifies the CROSS-GANG anti group — two
+    gangs carrying the SAME winning (selector, level) term and matching
+    it mutually must not share a domain within a cycle (ref
+    InterPodAffinity over virtually-allocated session state).
+
+    One group slot per gang: when a pod carries SEVERAL self-selecting
+    terms, only the coarsest one defines the group, so a peer sharing
+    only a finer term is not in-cycle-excluded against it (that pair
+    converges next cycle through the filter masks, like asymmetric
+    terms).  Coarsest-first is the conservative pick — it is the widest
+    exclusion the gang itself demands."""
     best, key = -1, ()
     for term in pod.pod_affinity:
         if not (term.required and term.anti and term.selects(pod.labels)):

@@ -7,6 +7,7 @@ from kai_scheduler_tpu.apis import types as apis
 from kai_scheduler_tpu.ops import drf
 from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
 from kai_scheduler_tpu.ops.scoring import PlacementConfig
+from kai_scheduler_tpu.runtime.cluster import Cluster
 from kai_scheduler_tpu.state import build_snapshot
 
 Vec = apis.ResourceVec
@@ -152,3 +153,90 @@ class TestEndToEndFraction:
         Binder().reconcile(cluster)
         devs = {cluster.pods[p.name].accel_devices[0] for p in p0}
         assert len(devs) == 1            # packed onto one shared device
+
+
+class TestReservations:
+    """Shared-device reservation lifecycle — the reservation-pod
+    analogue (``binder/binding/resourcereservation`` + the NVML agent in
+    ``cmd/resourcereservation``): one reservation per shared device,
+    sharers join/leave, the group dies with its last owner."""
+
+    @staticmethod
+    def _cluster():
+        nodes = [apis.Node(name="n0",
+                           allocatable=apis.ResourceVec(2.0, 32.0, 128.0),
+                           accel_memory_gib=16.0)]
+        queues = [apis.Queue(name="d", accel=apis.QueueResource(quota=4.0)),
+                  apis.Queue(name="q", parent="d",
+                             accel=apis.QueueResource(quota=4.0))]
+        groups, pods = [], []
+        for i in range(2):
+            groups.append(apis.PodGroup(name=f"f{i}", queue="q",
+                                        min_member=1))
+            pods.append(apis.Pod(name=f"f{i}-0", group=f"f{i}",
+                                 accel_portion=0.5))
+        return Cluster.from_objects(nodes, queues, groups, pods)
+
+    def test_sharers_join_one_reservation_and_release(self):
+        from kai_scheduler_tpu.binder.binder import Binder
+        from kai_scheduler_tpu.framework.scheduler import Scheduler
+        cluster = self._cluster()
+        Scheduler().run_once(cluster)
+        result = Binder().reconcile(cluster)
+        assert sorted(result.bound) == ["f0-0", "f1-0"]
+        devs = {cluster.pods[p].accel_devices[0] for p in result.bound}
+        if len(devs) == 1:  # gpupack default: both share one device
+            res = cluster.reservations.get("n0", devs.pop())
+            assert res is not None and res.owners == {"f0-0", "f1-0"}
+            assert res.uuid.startswith("accel://n0/")
+        assert len(cluster.reservations) == len(devs) or devs == set()
+        # last sharer leaving deletes the reservation
+        cluster.evict_pod("f0-0")
+        cluster.tick()
+        assert all("f0-0" not in r.owners
+                   for r in cluster.reservations.for_pod("f0-0"))
+        cluster.evict_pod("f1-0")
+        cluster.tick()
+        assert len(cluster.reservations) == 0
+
+    def test_rollback_leaves_group_clean(self):
+        from kai_scheduler_tpu.binder.binder import Binder, BinderPlugin
+        from kai_scheduler_tpu.framework.scheduler import Scheduler
+
+        class Boom:
+            name = "boom"
+
+            def pre_bind(self, cluster, pod, request):
+                raise RuntimeError("induced bind failure")
+
+            def post_bind(self, cluster, pod, request):
+                pass
+
+            def rollback(self, cluster, pod, request):
+                pass
+
+        from kai_scheduler_tpu.binder.binder import (
+            DynamicResourcesPlugin, GpuSharingPlugin, VolumeBindingPlugin)
+        cluster = self._cluster()
+        Scheduler().run_once(cluster)
+        binder = Binder(plugins=[VolumeBindingPlugin(),
+                                 DynamicResourcesPlugin(),
+                                 GpuSharingPlugin(), Boom()])
+        result = binder.reconcile(cluster)
+        assert result.bound == []
+        assert len(cluster.reservations) == 0  # acquire rolled back
+
+    def test_reservations_rebuilt_from_snapshot(self):
+        from kai_scheduler_tpu.binder.binder import Binder
+        from kai_scheduler_tpu.framework.scheduler import Scheduler
+        from kai_scheduler_tpu.runtime import snapshot
+        cluster = self._cluster()
+        Scheduler().run_once(cluster)
+        Binder().reconcile(cluster)
+        n_before = len(cluster.reservations)
+        back = snapshot.load_cluster(snapshot.dump_cluster(cluster))
+        assert len(back.reservations) == n_before
+        back.evict_pod("f0-0")
+        back.evict_pod("f1-0")
+        back.tick()
+        assert len(back.reservations) == 0
