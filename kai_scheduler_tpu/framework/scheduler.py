@@ -53,15 +53,26 @@ _PURE_ACTIONS = {
 }
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "actions", "num_levels", "acfg", "vcfg", "grace_s"))
-def _fused_pipeline(state, fair_share, *, actions, num_levels, acfg,
-                    vcfg, grace_s):
+def run_actions(state, fair_share, *, actions, num_levels, acfg, vcfg,
+                grace_s):
+    """Pure composition of the action pipeline over a fresh commit set —
+    shared by the jitted production pipeline below and by harnesses
+    (e.g. the multichip dryrun) that must compile EXACTLY what
+    production compiles."""
     res = init_result(state)
     for name in actions:
         res = _PURE_ACTIONS[name](state, fair_share, res, num_levels,
                                   acfg, vcfg, grace_s)
     return res
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "actions", "num_levels", "acfg", "vcfg", "grace_s"))
+def _fused_pipeline(state, fair_share, *, actions, num_levels, acfg,
+                    vcfg, grace_s):
+    return run_actions(state, fair_share, actions=actions,
+                       num_levels=num_levels, acfg=acfg, vcfg=vcfg,
+                       grace_s=grace_s)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,133 @@
+"""Multi-cycle soak: scheduler + binder + cluster lifecycle under churn.
+
+The reference's envtest/e2e tiers (SURVEY §4) drive the real scheduler,
+binder and controllers together against a live cluster.  This is that
+tier in-process: randomized workloads arrive and complete over many
+cycles; after EVERY cycle+bind+tick the cluster-wide invariants must
+hold:
+
+- node capacity is never exceeded by bound/running pods,
+- no accelerator device is double-booked (whole or fractional),
+- gang all-or-nothing EVENTUALLY: placement is all-or-nothing in-kernel,
+  but commits pipeline tasks that landed on releasing capacity into
+  later cycles, so a gang may be transiently part-bound; once the
+  system drains (no new arrivals), no gang may remain part-bound below
+  quorum,
+- every BindRequest a cycle cuts names a pod that was PENDING when the
+  cycle ran.
+"""
+import random
+
+import pytest
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.binder.binder import Binder
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+
+def _check_invariants(cluster: Cluster, final: bool = False):
+    # capacity + device booking per node
+    for node in cluster.nodes.values():
+        used = apis.ResourceVec()
+        device_share: dict[int, float] = {}
+        for pod in cluster.pods.values():
+            if pod.node != node.name or pod.status not in (
+                    apis.PodStatus.BOUND, apis.PodStatus.RUNNING,
+                    apis.PodStatus.RELEASING):
+                continue
+            used = used + pod.resources
+            if pod.accel_portion > 0:
+                for d in pod.accel_devices:
+                    device_share[d] = device_share.get(d, 0.0) \
+                        + pod.accel_portion
+            else:
+                for d in pod.accel_devices:
+                    device_share[d] = device_share.get(d, 0.0) + 1.0
+        assert used.cpu <= node.allocatable.cpu + 1e-6, node.name
+        assert used.memory <= node.allocatable.memory + 1e-6, node.name
+        for d, share in device_share.items():
+            assert d < int(round(node.allocatable.accel)), (node.name, d)
+            assert share <= 1.0 + 1e-6, (node.name, d, share)
+    # gang wholeness: strict only once the system has drained —
+    # transiently a gang may be part-bound while its remaining tasks
+    # are pipelined into later cycles (placed on releasing capacity)
+    if final:
+        for group in cluster.pod_groups.values():
+            bound = sum(
+                p.status in (apis.PodStatus.BOUND, apis.PodStatus.RUNNING)
+                for p in cluster.pods.values() if p.group == group.name)
+            total = sum(1 for p in cluster.pods.values()
+                        if p.group == group.name)
+            assert not (0 < bound < min(group.min_member, total)), (
+                group.name, bound, group.min_member, total)
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_lifecycle_soak(seed):
+    rng = random.Random(seed)
+    nodes = [apis.Node(name=f"n{i}",
+                       allocatable=apis.ResourceVec(4.0, 16.0, 64.0))
+             for i in range(8)]
+    queues = [apis.Queue(name="dept", accel=apis.QueueResource(quota=32.0)),
+              apis.Queue(name="qa", parent="dept",
+                         accel=apis.QueueResource(quota=16.0)),
+              apis.Queue(name="qb", parent="dept",
+                         accel=apis.QueueResource(quota=16.0))]
+    cluster = Cluster.from_objects(nodes, queues, [], [])
+    sched = Scheduler()
+    binder = Binder()
+    gang_seq = 0
+    placed_total = 0
+
+    for cycle in range(10):
+        # churn: a few new gangs arrive...
+        for _ in range(rng.randint(1, 3)):
+            size = rng.randint(1, 4)
+            gname = f"g{gang_seq}"
+            gang_seq += 1
+            pg = apis.PodGroup(name=gname,
+                               queue=rng.choice(["qa", "qb"]),
+                               min_member=size)
+            pods = []
+            for t in range(size):
+                frac = rng.random() < 0.2
+                pods.append(apis.Pod(
+                    name=f"{gname}-{t}", group=gname,
+                    resources=apis.ResourceVec(
+                        0.0 if frac else float(rng.randint(1, 2)),
+                        1.0, 2.0),
+                    accel_portion=0.5 if frac else 0.0))
+            cluster.submit(pg, pods)
+        # ... and a running gang occasionally completes
+        running_groups = sorted({
+            p.group for p in cluster.pods.values()
+            if p.status == apis.PodStatus.RUNNING})
+        if running_groups and rng.random() < 0.5:
+            done = rng.choice(running_groups)
+            for p in list(cluster.pods.values()):
+                if p.group == done:
+                    cluster.evict_pod(p.name)
+
+        pending_before = {p.name for p in cluster.pods.values()
+                          if p.status == apis.PodStatus.PENDING}
+        result = sched.run_once(cluster)
+        placed_total += len(result.bind_requests)
+        for br in result.bind_requests:
+            assert br.pod_name in pending_before, br.pod_name
+        bind = binder.reconcile(cluster)
+        assert not bind.failed, bind.failed
+        _check_invariants(cluster)
+        cluster.tick()
+        _check_invariants(cluster)
+
+    assert placed_total > 0
+    # the system drains: with enough repeat cycles and no new arrivals,
+    # everything pending either places or is genuinely over capacity —
+    # and no gang may remain part-bound below quorum
+    for _ in range(5):
+        sched.run_once(cluster)
+        binder.reconcile(cluster)
+        cluster.tick()
+        _check_invariants(cluster)
+    _check_invariants(cluster, final=True)
