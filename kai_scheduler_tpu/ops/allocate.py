@@ -266,7 +266,8 @@ def _attempt_gang_in_domain(
         quota: jax.Array | None = None,    # i32 [] max new placements
         ext_free: jax.Array | None = None,  # f32 [N, E] extended pool
         extra_extended_releasing: jax.Array | None = None,  # f32 [N, E]
-        banned_doms: jax.Array | None = None  # i32 [S] domains to avoid
+        banned_doms: jax.Array | None = None,  # i32 [S] domains to avoid
+        score_bias: jax.Array | None = None  # f32 [N] extra score band
 ):
     """Place one gang greedily within ``domain_mask`` — the task loop of
     ``allocateTask`` (``actions/common/allocate.go:229``) including the
@@ -503,6 +504,8 @@ def _attempt_gang_in_domain(
                        + n.soft_scores[task_class[t]]
                        + jnp.where(jnp.arange(N) == task_nom[t],
                                    W_NOMINATED, 0.0))
+        if score_bias is not None:
+            extra_bands = extra_bands + score_bias
         if config.track_devices:
             portion_n = node_portion(n, task_portion[t], task_mem[t])  # [N]
             extra_bands = extra_bands + gpu_sharing_score(
@@ -642,6 +645,7 @@ def _attempt_gang_in_domain_uniform(
         ext_free: jax.Array | None = None,
         extra_extended_releasing: jax.Array | None = None,
         banned_doms: jax.Array | None = None,
+        score_bias: jax.Array | None = None,
         topo_tables=None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
@@ -813,9 +817,12 @@ def _attempt_gang_in_domain_uniform(
             jnp.float32)                                # [N]
 
     # ---- scores (one pass; locality band anchored at the best node) -----
+    extra_bands_u = tie_jitter + n.soft_scores[task_class]
+    if score_bias is not None:
+        extra_bands_u = extra_bands_u + score_bias
     scores0 = score_nodes_for_task(
         n, free, req, fit_idle, fit_pipe, config.placement,
-        extra=tie_jitter + n.soft_scores[task_class])   # [N]
+        extra=extra_bands_u)                            # [N]
     best = jnp.argmax(scores0)
     topo_band = jnp.where(
         has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
@@ -884,7 +891,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   ext_free: jax.Array | None = None,
                   extra_extended_releasing: jax.Array | None = None,
                   topo_tables=None,
-                  domain_mask: jax.Array | None = None):
+                  domain_mask: jax.Array | None = None,
+                  score_bias: jax.Array | None = None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -927,7 +935,7 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
             num_levels, config, dmask, pref_doms, has_pref,
             extra_releasing, extra_device_releasing, lane, chain,
             prior_nodes, quota, ext_free, extra_extended_releasing,
-            banned, *extras)
+            banned, score_bias, *extras)
 
     out = run(None)
     if config.subgroup_topology and not config.uniform_tasks:

@@ -29,6 +29,15 @@ W_GPU_SHARING = 1_000.0
 W_TOPOLOGY = 10_000.0
 W_K8S_PLUGINS = 100_000.0
 W_NOMINATED = 1_000_000.0
+#: wavefront-only band (no reference counterpart): a victim-action lane
+#: prefers nodes freed by ITS OWN victim range — the sequential solver
+#: implicitly does this (each preemptor is placed right after its own
+#: victims flip to Releasing, so the newly-available capacity IS its
+#: victims').  Sits below W_AVAILABILITY so genuinely idle-fit nodes
+#: still win, and above the binpack/spread band so parallel lanes stop
+#: argmaxing onto the same freed nodes (the cross-lane conflicts that
+#: serialized the victim wavefront).
+W_OWN_FREED = 50.0
 
 BIG_NEG = -1e30
 

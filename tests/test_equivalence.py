@@ -58,12 +58,17 @@ def test_chunked_reclaim_matches_sequential(seed):
     assert outs[16]["victims"] <= outs[1]["victims"], outs
 
 
-@pytest.mark.parametrize("seed", [0, 3])
-def test_chunked_preempt_matches_sequential(seed):
+@pytest.mark.parametrize("seed,departments,leaves", [
+    (0, 1, 1), (3, 1, 1),
+    # multi-queue: preempt chunks must stay own-queue-local (a lane's
+    # budget prices against its own queue's victims only)
+    (0, 2, 2), (1, 2, 2),
+])
+def test_chunked_preempt_matches_sequential(seed, departments, leaves):
     nodes, queues, groups, pods, topo = make_cluster(
         num_nodes=16, node_accel=2.0, num_gangs=10, tasks_per_gang=2,
-        running_fraction=0.6, num_departments=1, queues_per_department=1,
-        priority_spread=3, seed=seed)
+        running_fraction=0.6, num_departments=departments,
+        queues_per_department=leaves, priority_spread=3, seed=seed)
     ses = Session.open(nodes, queues, groups, pods, topo)
     outs = {}
     for b in (1, 8):
@@ -75,6 +80,8 @@ def test_chunked_preempt_matches_sequential(seed):
         outs[b] = res
     assert (np.asarray(outs[1].allocated)
             == np.asarray(outs[8].allocated)).all()
+    assert (int(np.asarray(outs[8].victim).sum())
+            <= int(np.asarray(outs[1].victim).sum()))
 
 
 @pytest.mark.parametrize("seed", [0, 1, 4])
