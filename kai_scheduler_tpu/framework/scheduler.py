@@ -252,8 +252,14 @@ class Scheduler:
             self._elector = LeaderElector(self.config.leader_lease,
                                           self.config.identity)
         #: cycle-side view of fit-failure counts whose status writes may
-        #: still be queued (see _record_fit_status)
+        #: still be queued (see _record_fit_status).  Scoped to ONE
+        #: cluster document: the HTTP server reuses a Scheduler across
+        #: POST /cycle requests, and a stale entry for a same-named gang
+        #: of an unrelated document would inflate its failure count —
+        #: ``_fit_shadow_cluster`` (a weakref) detects the switch and
+        #: clears the shadow.
         self._fit_shadow: dict[str, int] = {}
+        self._fit_shadow_cluster = None
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
@@ -295,6 +301,15 @@ class Scheduler:
         if self.usage_lister is not None:
             self.usage_lister.maybe_fetch(cluster.now)
             queue_usage = self.usage_lister.queue_usage(cluster.now)
+        # NOTE on concurrent status writes: the cycle NEVER blocks on the
+        # async status pool (a slow store must not stall scheduling —
+        # test-pinned), so a snapshot can race an in-flight apply.  Each
+        # attribute store is GIL-atomic, applies are serialized under the
+        # updater's apply_lock, and the apply closures order their writes
+        # so every observable prefix is a conservative state (see
+        # _record_fit_status) — a racing snapshot at worst treats a gang
+        # as schedulable for one extra cycle, never spuriously
+        # unschedulable with a stale reason.
         session = Session.open(
             *self._shard_filter(*cluster.snapshot_lists()),
             config=self.config.session,
@@ -410,13 +425,26 @@ class Scheduler:
             else:
                 self.status_updater.enqueue(key, fn)
 
+        import weakref
+        if (self._fit_shadow_cluster is None
+                or self._fit_shadow_cluster() is not cluster):
+            self._fit_shadow.clear()
+            self._fit_shadow_cluster = weakref.ref(cluster)
         shadow = self._fit_shadow
 
+        # Write ORDER inside the apply closures matters: a racing
+        # snapshot (the cycle never blocks on the status pool) observes
+        # some GIL-atomic prefix of these stores, so each prefix must be
+        # a conservative state.  reset() clears the skip flag FIRST (a
+        # partially-reset gang is at worst re-attempted with a stale
+        # count); fail() sets the flag/phase LAST (a partially-failed
+        # gang is at worst attempted one more cycle — never skipped with
+        # a stale reason).
         def reset(group):
             def apply():
-                group.fit_failures = 0
                 group.unschedulable = False
                 group.unschedulable_reason = ""
+                group.fit_failures = 0
             return apply
 
         def fail(group, failures, reason):
@@ -427,16 +455,22 @@ class Scheduler:
                 group.fit_failures = failures
                 group.unschedulable_reason = reason
                 if unsched:
-                    group.unschedulable = True
                     group.phase = apis.PodGroupPhase.UNSCHEDULABLE
+                    group.unschedulable = True
             return apply
 
         for gi in np.nonzero(allocated[:len(names)])[0]:
             group = cluster.pod_groups.get(names[gi])
             if group is None:
                 continue
-            had = shadow.pop(names[gi], None)
-            if had is not None or group.fit_failures or group.unschedulable:
+            had = shadow.get(names[gi])
+            if had or group.fit_failures or group.unschedulable:
+                # record the reset IN the shadow (0), don't drop the
+                # entry: per-key coalescing means a later fail write can
+                # supersede this queued reset, and reading the stale
+                # pre-reset group.fit_failures then would prematurely
+                # trip the unschedulable backoff
+                shadow[names[gi]] = 0
                 write(names[gi], reset(group))
         for name, reason in explanations.items():
             group = cluster.pod_groups.get(name)

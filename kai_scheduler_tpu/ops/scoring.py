@@ -33,11 +33,12 @@ W_NOMINATED = 1_000_000.0
 #: prefers nodes freed by ITS OWN victim range — the sequential solver
 #: implicitly does this (each preemptor is placed right after its own
 #: victims flip to Releasing, so the newly-available capacity IS its
-#: victims').  Sits below W_AVAILABILITY so genuinely idle-fit nodes
-#: still win, and above the binpack/spread band so parallel lanes stop
-#: argmaxing onto the same freed nodes (the cross-lane conflicts that
-#: serialized the victim wavefront).
-W_OWN_FREED = 50.0
+#: victims').  Slotted strictly between the binpack/spread density band
+#: (raw <= MAX_HIGH_DENSITY) and W_RESOURCE_TYPE, so it breaks the
+#: cross-lane argmax collisions that serialized the victim wavefront
+#: WITHOUT overriding any reference plugin band (a CPU-only preemptor
+#: still prefers a CPU-only node over its own freed accel node).
+W_OWN_FREED = 9.5
 
 BIG_NEG = -1e30
 

@@ -519,8 +519,18 @@ def solve_for_preemptor(
     # placement attempts.  Consolidation's allPodsReallocated validator
     # is NOT monotone (extra victims must also re-place), so it keeps
     # the reference's linear first-success walk — num_units is already
-    # capped by max_consolidation_preemptees.
-    if consolidate:
+    # capped by max_consolidation_preemptees.  Subgroup-topology
+    # placement through the per-task kernel is not monotone either: the
+    # aggregate-capacity domain gate can pass while the fill fails on a
+    # fragmented domain, so attempt(hi) may fail where a smaller prefix
+    # succeeds, and the bisect can settle on a non-minimal k — those
+    # snapshots take the linear walk too (the uniform kernel's domain
+    # pick counts real per-node replica capacities, so it stays
+    # monotone and keeps the bisect).
+    linear_walk = consolidate or (
+        config.placement.subgroup_topology
+        and not config.placement.uniform_tasks)
+    if linear_walk:
         def search(_):
             def cond_l(c):
                 k, done, _ = c

@@ -38,6 +38,14 @@ class AsyncStatusUpdater:
         self._queue: "queue.Queue[str | None]" = queue.Queue()
         self._latest: dict[str, StatusUpdate] = {}
         self._lock = threading.Lock()
+        #: serializes ``apply()`` across the worker pool, so two workers
+        #: never interleave writes to one object.  The CYCLE thread does
+        #: NOT take this lock (a slow store must never stall the cycle):
+        #: snapshot-vs-apply tearing is instead prevented by the write
+        #: ORDERING inside the apply closures — every GIL-atomic prefix
+        #: a racing snapshot can observe is a conservative state (see
+        #: ``Scheduler._record_fit_status``).
+        self.apply_lock = threading.Lock()
         self._inflight = 0
         self._applied = 0
         self._errors = 0
@@ -83,7 +91,8 @@ class AsyncStatusUpdater:
             if update is None:
                 continue
             try:
-                update.apply()
+                with self.apply_lock:
+                    update.apply()
                 self._applied += 1
             except Exception:  # noqa: BLE001 — a failed write never
                 self._errors += 1  # stalls the pool (reference logs+drops)
