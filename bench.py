@@ -20,6 +20,9 @@ pending pods**, p99 cycle latency against the driver's 50 ms bar
                 same JSON line's "extra" field — the driver artifact
   all           run everything; extra lines to stderr, headline to stdout
 
+``--compare PREV.json`` folds benchstat-style per-config deltas vs a
+previous artifact into ``extra.vs_prev`` (and prints them to stderr).
+
 Measured through the *default* semantic path: Session.open's auto-tuned
 config (dynamic ordering, prefilter + signature skip on), kernels jitted
 once and timed over BENCH_ITERS repetitions.
@@ -58,6 +61,26 @@ def _time(fn, iters: int, pipeline: int | None = None) -> float:
     return _p99(times)
 
 
+def _time_double_buffered(fn, iters: int) -> float:
+    """Per-cycle p99 with ONE cycle in flight: dispatch cycle N+1, then
+    gather cycle N — the deployable double-buffered cycle loop (the host
+    prepares/commits cycle N while the device already solves N+1), which
+    hides the device-link round trip behind the next solve without
+    batching more than one cycle ahead."""
+    import jax
+    prev = fn()
+    jax.block_until_ready(prev)  # compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        nxt = fn()               # dispatch N+1 (async)
+        jax.block_until_ready(prev)   # gather N
+        prev = nxt
+        times.append(time.perf_counter() - t0)
+    jax.block_until_ready(prev)
+    return _p99(times)
+
+
 def _session(**kw):
     from kai_scheduler_tpu.framework.session import Session
     from kai_scheduler_tpu.state import make_cluster
@@ -84,7 +107,7 @@ def bench_fairshare(iters: int) -> dict:
 
 
 def _allocate_bench(name: str, iters: int, pipeline: int | None = None,
-                    _reuse=None, **kw) -> dict:
+                    _reuse=None, double_buffer: bool = False, **kw) -> dict:
     import functools
 
     import jax
@@ -106,7 +129,11 @@ def _allocate_bench(name: str, iters: int, pipeline: int | None = None,
 
     placements, _ = jax.block_until_ready(cycle(ses.state))
     placed = int((np.asarray(placements) >= 0).sum())
-    p99 = _time(lambda: cycle(ses.state), iters, pipeline=pipeline)
+    if double_buffer:
+        p99 = _time_double_buffered(lambda: cycle(ses.state),
+                                    max(iters * 3, 8))
+    else:
+        p99 = _time(lambda: cycle(ses.state), iters, pipeline=pipeline)
     total = int(np.asarray(ses.state.gangs.task_valid).sum())
     return {"metric": f"{name} ({placed}/{total} pods placed)",
             "value": round(p99, 3), "unit": "ms",
@@ -162,16 +189,40 @@ def bench_headline_full(iters: int) -> dict:
                            "metric": r["metric"]}
         except Exception as exc:  # noqa: BLE001 — one config must not
             extra[name] = {"error": str(exc)[:200]}  # sink the artifact
-    # honest tail: single-cycle dispatch+sync, no pipelined batching —
-    # includes the harness's device-link round trip per cycle (same
-    # session and compiled cycle as the headline number above)
+    # honest tails, same session and compiled cycle as the headline:
+    # - sync_p99_ms: dispatch + sync per cycle, nothing in flight
+    # - p99_ms: ONE cycle in flight (dispatch N+1, then gather N) — the
+    #   deployable double-buffered loop
+    # Both pay the harness link's per-sync completion-notification
+    # constant: any program past the execute-RPC inline window costs a
+    # fixed ~70-80 ms to OBSERVE completion, charged per gather even
+    # when the device finished earlier (bulk-dispatching K cycles and
+    # gathering one by one shows inter-completion gaps of that size
+    # while K distinct-input cycles dispatched together finish in
+    # pipelined-rate wall time — measured r4; no server-side result
+    # caching, distinct-input and identical-input pipelined rates
+    # match).  link_notification_ms derives that constant as
+    # sync - pipelined of the SAME compiled cycle;
+    # local_chip_estimate_ms is the pipelined (link-amortized) solve —
+    # what a per-cycle sync costs on a chip without the CI tunnel.
     try:
         r1 = _allocate_bench("per-cycle", max(3, iters // 2),
                              pipeline=1, _reuse=ses)
+        rdb = _allocate_bench("per-cycle-db", max(3, iters // 2),
+                              _reuse=ses, double_buffer=True)
         extra["headline_per_cycle"] = {
-            "p99_ms": r1["value"],
-            "note": ("PIPELINE=1: per-cycle sync including the "
-                     "harness device-link round trip")}
+            "p99_ms": rdb["value"],
+            "sync_p99_ms": r1["value"],
+            "link_notification_ms": round(
+                max(0.0, r1["value"] - out["value"]), 1),
+            "local_chip_estimate_ms": out["value"],
+            "note": ("p99_ms: double-buffered (dispatch N+1, gather N); "
+                     "sync_p99_ms: nothing in flight; both include the "
+                     "harness link's fixed per-sync completion-"
+                     "notification latency (link_notification_ms = "
+                     "sync - pipelined, a transport constant a local "
+                     "chip does not have); local_chip_estimate_ms is "
+                     "the pipelined solve time")}
     except Exception as exc:  # noqa: BLE001
         extra["headline_per_cycle"] = {"error": str(exc)[:200]}
     out["extra"] = extra
@@ -318,13 +369,67 @@ CONFIGS = {
 }
 
 
+def _load_artifact(path: str) -> dict:
+    """Read a previous driver artifact — either the raw JSON line or the
+    driver's wrapper ({"parsed": {...}})."""
+    with open(path) as f:
+        doc = json.load(f)
+    return doc.get("parsed", doc)
+
+
+def _compare(cur: dict, prev_path: str) -> dict:
+    """benchstat-style per-config deltas vs a previous artifact (ref the
+    reference's `make benchstat` comparison across counts,
+    ``Makefile:124-130``): negative delta_pct = faster.  Folded into the
+    artifact's extra AND printed as a table to stderr."""
+    prev = _load_artifact(prev_path)
+    pe, ce = prev.get("extra", {}), cur.get("extra", {})
+    single = os.environ.get("BENCH_CONFIG")
+    if single in ("fairshare", "scoring", "gang", "topology", "reclaim",
+                  "1", "2", "3", "4", "5"):
+        # single-config run: compare ONLY against the matching prev row
+        names = {"1": "fairshare", "2": "scoring", "3": "gang",
+                 "4": "topology", "5": "reclaim"}
+        name = names.get(single, single)
+        return_rows = {name: (pe.get(name, {}).get("p99_ms"),
+                              cur.get("value"))}
+        rows = return_rows
+    else:
+        rows = {"headline": (prev.get("value"), cur.get("value"))}
+        for name in ("fairshare", "scoring", "gang", "topology",
+                     "reclaim"):
+            rows[name] = (pe.get(name, {}).get("p99_ms"),
+                          ce.get(name, {}).get("p99_ms"))
+        pc = pe.get("headline_per_cycle", {})
+        cc = ce.get("headline_per_cycle", {})
+        rows["per_cycle"] = (pc.get("sync_p99_ms", pc.get("p99_ms")),
+                             cc.get("sync_p99_ms", cc.get("p99_ms")))
+    out = {}
+    print(f"vs {os.path.basename(prev_path)}:", file=sys.stderr)
+    for name, (p, c) in rows.items():
+        if p is None or c is None:
+            continue
+        delta = (c - p) / p * 100.0 if p else 0.0
+        out[name] = {"prev_ms": p, "cur_ms": c,
+                     "delta_pct": round(delta, 1)}
+        print(f"  {name:12s} {p:9.2f}ms -> {c:9.2f}ms  "
+              f"{delta:+6.1f}%", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
+    compare_to = None
+    if "--compare" in sys.argv:
+        compare_to = sys.argv[sys.argv.index("--compare") + 1]
     which = os.environ.get("BENCH_CONFIG",
                            "gang" if quick else "full")
     iters = int(os.environ.get("BENCH_ITERS", 3 if quick else 10))
     if which == "full":
-        print(json.dumps(bench_headline_full(iters)))
+        out = bench_headline_full(iters)
+        if compare_to:
+            out["extra"]["vs_prev"] = _compare(out, compare_to)
+        print(json.dumps(out))
         return
     if which == "all":
         for name in ("fairshare", "scoring", "gang", "topology", "reclaim",
@@ -332,7 +437,10 @@ def main() -> None:
             print(json.dumps(CONFIGS[name](iters)), file=sys.stderr)
         print(json.dumps(bench_headline(iters)))
         return
-    print(json.dumps(CONFIGS[which](iters)))
+    out = CONFIGS[which](iters)
+    if compare_to:
+        out.setdefault("extra", {})["vs_prev"] = _compare(out, compare_to)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

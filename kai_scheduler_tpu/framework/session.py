@@ -139,21 +139,15 @@ class Session:
                     config.allocate, track_devices=devices,
                     uniform_tasks=uniform, subgroup_topology=sub_topo,
                     extended=ext, dense_feasibility=dense,
-                    anti_groups=index.has_anti_groups,
-                    # 0 when disabled (the count is behaviorally dead
-                    # then), padded to a power of two when enabled —
-                    # AllocateConfig is a STATIC jit arg, so every
-                    # distinct value is a fresh XLA compile
-                    num_anti_groups=(
-                        1 << max(0, index.num_anti_groups - 1)
-                        .bit_length() if index.has_anti_groups else 0)),
+                    anti_groups=index.has_anti_groups),
                 victims=dataclasses.replace(
                     config.victims,
                     chunk_reclaim=not index.has_reclaim_minruntime,
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
-                        extended=ext, dense_feasibility=dense)))
+                        extended=ext, dense_feasibility=dense,
+                        anti_groups=index.has_anti_groups)))
         fair_share = _set_fair_share_jit(
             state, num_levels=config.num_levels,
             k_value=jnp.float32(config.k_value))

@@ -101,13 +101,23 @@ class AllocationResult(struct.PyTreeNode):
     #: nodes for its tasks), 2 = an equivalent gang already failed
     #: (signature skip), 3 = placement attempt failed — i32 [G]
     fit_reason: jax.Array
+    #: in-cycle claimed-domain table — bool [TA+1, AD+1]: row = exclusion
+    #: term (see ``GangState.anti_marks``; TA = junk row), column = dense
+    #: (node, level) domain id with per-node slots appended (AD = junk).
+    #: Shared by ALL placement actions (allocate and the victim
+    #: wavefronts), so a reclaim-placed preemptor excludes later
+    #: conflicting placements within the same cycle.
+    anti_used: jax.Array
 
 
 def init_result(state: ClusterState) -> AllocationResult:
     """Fresh commit set at cycle start (an empty Statement)."""
     g, n, q = state.gangs, state.nodes, state.queues
     G, T = g.g, g.t
+    TA = g.anti_term_level.shape[0]
+    AD = n.n * n.topology.shape[1] + n.n
     return AllocationResult(
+        anti_used=jnp.zeros((TA + 1, AD + 1), bool),
         placements=jnp.full((G, T), -1, jnp.int32),
         extended_free=n.extended_free,
         placement_device=jnp.full((G, T), -1, jnp.int32),
@@ -125,6 +135,86 @@ def init_result(state: ClusterState) -> AllocationResult:
         victim_move=jnp.full((state.running.m,), -1, jnp.int32),
         fit_reason=jnp.zeros((G,), jnp.int32),
     )
+
+
+def anti_domain_tables(state: ClusterState):
+    """Static per-LEVEL dense domain ids for the in-cycle exclusion
+    table (``AllocationResult.anti_used``): ``dom_static`` [L+1, N] —
+    rows 0..L-1 are the topology levels (a node LACKING the level's
+    label is its own per-node domain: upstream anti-affinity treats a
+    missing topology key as no shared domain), row L is the per-node
+    granularity; padded node slots map to the junk id AD."""
+    n = state.nodes
+    N, L = n.n, n.topology.shape[1]
+    ND = N * L
+    AD = ND + N
+    node_slot = ND + jnp.arange(N)
+    rows = []
+    for lvl in range(L):
+        by = n.topology[:, lvl]
+        rows.append(jnp.where(n.valid,
+                              jnp.where(by >= 0, by, node_slot), AD))
+    rows.append(jnp.where(n.valid, node_slot, AD))
+    return jnp.stack(rows), state.gangs.anti_term_level.shape[0]
+
+
+def anti_forbid_nodes(state: ClusterState, anti_used: jax.Array,
+                      dom_static: jax.Array, gang_idx: jax.Array):
+    """bool [..., N] — nodes whose domain is already claimed in any of
+    the gang's avoid rows this cycle (``gang_idx`` scalar or batched).
+    Shared by the allocate wavefront and both victim paths."""
+    g = state.gangs
+    L = state.nodes.topology.shape[1]
+    TA = g.anti_term_level.shape[0]
+    assert TA > 0, "anti kernels compiled without terms"
+    avoids = g.anti_avoids[jnp.maximum(gang_idx, 0)]       # [..., KT]
+    t_safe = jnp.clip(avoids, 0, TA - 1)
+    lvl = g.anti_term_level[t_safe]
+    doms = dom_static[jnp.clip(lvl, 0, L)]                 # [..., KT, N]
+    hit = anti_used[t_safe[..., None], doms]
+    return jnp.any(hit & (avoids >= 0)[..., None], axis=-2)
+
+
+def anti_mark_placements(state: ClusterState, anti_used: jax.Array,
+                         dom_static: jax.Array, gang_idx: jax.Array,
+                         nodes_t: jax.Array, valid: jax.Array):
+    """Claim the committed placements' domains in the gang's mark rows
+    (junk row/column absorb unused slots; ``valid`` gates whole
+    gangs/lanes)."""
+    g, n = state.gangs, state.nodes
+    L = n.topology.shape[1]
+    TA = g.anti_term_level.shape[0]
+    assert TA > 0, "anti kernels compiled without terms"
+    AD = n.n * L + n.n
+    marks = g.anti_marks[jnp.maximum(gang_idx, 0)]         # [..., KT]
+    t_safe = jnp.clip(marks, 0, TA - 1)
+    lvl = g.anti_term_level[t_safe]
+    placed = (nodes_t >= 0) & valid[..., None]             # [..., T]
+    doms = dom_static[jnp.clip(lvl, 0, L)[..., None],
+                      jnp.maximum(nodes_t, 0)[..., None, :]]  # [.., KT, T]
+    ok = placed[..., None, :] & (marks >= 0)[..., None]
+    rows = jnp.where(ok, t_safe[..., None], TA)
+    cols = jnp.where(ok, doms, AD)
+    return anti_used.at[rows, cols].max(True)
+
+
+def anti_defer_lanes(state: ClusterState, cand_g: jax.Array,
+                     cand_valid: jax.Array):
+    """bool [B] — lanes whose avoid rows intersect an EARLIER valid
+    lane's mark rows this chunk: they conflict-retry next chunk against
+    the updated table (at most one side of a conflicting pair lands per
+    chunk, mirroring the reference's one-at-a-time virtual updates)."""
+    g = state.gangs
+    B = cand_g.shape[0]
+    marks = g.anti_marks[jnp.maximum(cand_g, 0)]           # [B, KT]
+    avoids = g.anti_avoids[jnp.maximum(cand_g, 0)]
+    inter = jnp.any(
+        (avoids[:, None, :, None] == marks[None, :, None, :])
+        & (avoids >= 0)[:, None, :, None]
+        & (marks >= 0)[None, :, None, :], axis=(2, 3))     # [B, B]
+    earlier = jnp.arange(B)[None, :] < jnp.arange(B)[:, None]
+    return jnp.any(inter & earlier & cand_valid[None, :], axis=1) \
+        & cand_valid
 
 
 def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
@@ -227,9 +317,11 @@ class AllocateConfig:
     subgroup_topology: bool = True
     #: compile extended scalar-resource (MIG/DRA) fit + accounting.
     #: False when the snapshot carries none.  Session derives this
-    #: automatically.  Extended enforcement covers the allocate path;
-    #: victim scenarios do not credit evicted pods' extended resources
-    #: (conservative for preemptors that need them).
+    #: automatically.  Enforcement covers allocate AND the victim
+    #: scenarios: evicted pods' extended resources are credited back to
+    #: their node's pipeline-fit pool (``extended_releasing_extra``), so
+    #: a preemptor that needs a MIG slice held only by victims can
+    #: reclaim it (see ``freed_by_mask``/``ops/victims.py`` freed_ext).
     extended: bool = False
     #: node feasibility spans the whole node axis (no selectors, filter
     #: classes, anti-affinity, or topology domains anywhere in the
@@ -240,14 +332,13 @@ class AllocateConfig:
     #: skip gangs whose scheduling signature already failed this action —
     #: ref ``actions/common/minimal_job_comparison.go`` (MinimalJobRepresentatives)
     signature_skip: bool = True
-    #: track cross-gang required anti-affinity domains IN-CYCLE: gangs
-    #: sharing an anti group (mutual required anti terms) may not land
-    #: in one domain within a single allocate action (ref
-    #: InterPodAffinity over virtually-allocated session state).  The
-    #: Session enables this only when the snapshot holds >=2 gangs in
-    #: one group; ``num_anti_groups`` sizes the tracking table.
+    #: track in-cycle exclusion terms (mutual AND asymmetric required
+    #: anti-affinity between pending gangs, plus shared host ports) in
+    #: the cycle's claimed-domain table — ref InterPodAffinity /
+    #: NodePorts over virtually-allocated session state.  The Session
+    #: enables this when the snapshot emitted term rows
+    #: (``GangState.anti_marks``); the table is sized from the state.
     anti_groups: bool = False
-    num_anti_groups: int = 0
 
 
 def _attempt_gang_in_domain(
@@ -1015,8 +1106,25 @@ def allocate(
     if not config.dynamic_order:
         order0 = ordering.job_order_perm(
             g, q, init.queue_allocated, fair_share, total, remaining0)
-        static_rank = jnp.zeros((G,), jnp.float32).at[order0].set(
-            jnp.arange(G, dtype=jnp.float32))
+        static_rank = jnp.zeros((G,), jnp.int32).at[order0].set(
+            jnp.arange(G, dtype=jnp.int32))
+    else:
+        # Dynamic ordering decomposes the two-level heap: only the
+        # QUEUE-level keys are live (allocation moves them); the job
+        # keys (below-min, priority, creation) are snapshot-static.  The
+        # per-chunk [G] 8-key lexsort is therefore replaced by a hoisted
+        # static job rank + a per-chunk sort-free [Q,Q] dense queue-
+        # class rank + ONE single-key argsort — the same total order,
+        # fewer in-loop sort kernels (sorts in while_loop bodies carry a
+        # large fixed cost on this platform).
+        below_min = g.running_count < g.min_member
+        sjr_perm = jnp.lexsort((
+            g.creation_order.astype(jnp.float32),
+            -g.priority.astype(jnp.float32),
+            (~below_min).astype(jnp.float32)))
+        static_job_rank = jnp.zeros((G,), jnp.int32).at[sjr_perm].set(
+            jnp.arange(G, dtype=jnp.int32))                   # [G]
+    gq_idx = jnp.maximum(g.queue, 0)
 
     chain = _chain_membership(q.parent, num_levels)
 
@@ -1033,65 +1141,108 @@ def allocate(
             level_of_dom = level_of_dom.at[ids_l].set(lvl)
         level_of_dom = level_of_dom[:ND]
 
-    def topo_tables_for(free, dev, qa):
-        """Chunk-hoisted domain tables for the uniform+topology path:
-        per-TYPE replica capacity per domain and ONE fullest-first
-        domain order — the per-lane argsort/segment-sums they replace
-        dominated the wavefront (they are lane-independent)."""
-        avail = free + n.releasing + extra
-        zero = jnp.zeros((), free.dtype)
+    if hoist_topo:
+        Y = g.type_req.shape[0]
+        #: node → dense domain id per level (static; junk ND)
+        dom_of = jnp.stack([
+            jnp.where(n.valid & (n.topology[:, lvl] >= 0),
+                      n.topology[:, lvl], ND)
+            for lvl in range(L)])                             # [L, N]
+        #: static (capacity-independent + build-capacity) feasibility —
+        #: free only SHRINKS within allocate, so a node infeasible at
+        #: build never recovers and the live replica count alone tracks
+        #: capacity afterwards
+        zero_s = jnp.zeros((), n.free.dtype)
+        fp_build = jax.vmap(lambda y: feasible_nodes_dual(
+            n, g.type_req[y], g.type_selector[y], zero_s, zero_s,
+            free=init.free, device_free=init.device_free,
+            extra_releasing=extra, extra_device_releasing=extra_dev,
+            devices=False, task_class=g.type_class[y])[1])(
+                jnp.arange(Y)) & n.valid[None, :]             # [Y, N]
 
-        def caps_of_type(y):
-            _, fp = feasible_nodes_dual(
-                n, g.type_req[y], g.type_selector[y], zero, zero,
-                free=free, device_free=dev, extra_releasing=extra,
-                extra_device_releasing=extra_dev, devices=False,
-                task_class=g.type_class[y])
-            req = g.type_req[y]
-            c = jnp.where(req > EPS,
-                          (avail + EPS) / jnp.maximum(req, EPS)[None, :],
-                          jnp.inf)
-            c = jnp.floor(jnp.min(c, axis=-1))
-            c = jnp.where(fp & n.valid,
-                          jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+        def _replicas_at(avail_rows):
+            """Replica counts per type for the given avail rows [K, R]
+            (the capacity part of caps_of_type, recomputable per touched
+            node without the feasibility machinery)."""
+            def per_type(y):
+                req = g.type_req[y]
+                c = jnp.where(req[None, :] > EPS,
+                              (avail_rows + EPS)
+                              / jnp.maximum(req, EPS)[None, :], jnp.inf)
+                return jnp.clip(jnp.floor(jnp.min(c, axis=-1)),
+                                0.0, 1e9).astype(jnp.int32)
+            return jax.vmap(per_type)(jnp.arange(Y))          # [Y, K]
+
+    def topo_tables_build(free):
+        """Initial domain tables for the uniform+topology path: per-TYPE
+        replica capacity per node (``c_y``, junk column N) and per
+        domain (``dom_caps_y``), plus the per-domain aggregate accel.
+        Built ONCE per action; chunks maintain all three incrementally —
+        only nodes touched by committed placements change, so the
+        full per-chunk rebuild (per-type feasibility + divisions + Y·L
+        node-axis reductions, the dominant wavefront cost at 5k nodes ×
+        3 levels) reduces to placement-sized gathers and L sparse
+        scatter-adds."""
+        avail = free + n.releasing + extra
+        c_all = _replicas_at(avail)                           # [Y, N]
+        c_all = jnp.where(fp_build, c_all, 0)
+        c_y = jnp.concatenate(
+            [c_all, jnp.zeros((Y, 1), jnp.int32)], axis=1)    # [Y, N+1]
+
+        def caps_of_type(c_row):
             caps = jnp.zeros((ND + 1,), jnp.int32)
             for lvl in range(L):
-                ids_l = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
-                                  n.topology[:, lvl], ND)
-                caps = caps.at[ids_l].add(c)
+                caps = caps.at[dom_of[lvl]].add(c_row)
             return caps[:ND]
 
-        dom_caps_y = jax.vmap(caps_of_type)(
-            jnp.arange(g.type_req.shape[0]))                 # [Y, ND]
+        dom_caps_y = jax.vmap(caps_of_type)(c_all)            # [Y, ND]
         agg = jnp.zeros((ND + 1,), free.dtype)
         for lvl in range(L):
-            ids_l = jnp.where(n.valid & (n.topology[:, lvl] >= 0),
-                              n.topology[:, lvl], ND)
-            agg = agg.at[ids_l].add(
+            agg = agg.at[dom_of[lvl]].add(
                 jnp.where(n.valid, avail[:, 0], 0.0))
-        order_by_agg = jnp.argsort(
-            jnp.where(level_of_dom >= 0, agg[:ND], jnp.inf))
-        return dom_caps_y, level_of_dom, order_by_agg
+        return dom_caps_y, agg[:ND], c_y
 
-    # cross-gang anti-affinity tracking (config.anti_groups): dense
+    def topo_tables_update(dom_caps_y, agg, c_y, free_new,
+                           take, cand, nodes_b):
+        """Incremental maintenance after a chunk's commit: recompute
+        replica counts for the touched nodes only (duplicate touches
+        write identical values, so scatter-set is well defined), then
+        push the per-node deltas into the domain tables."""
+        B_, T_ = nodes_b.shape
+        placed = take[:, None] & (nodes_b >= 0)               # [B, T]
+        idxs = jnp.where(placed, nodes_b, n.n).ravel()        # [K] junk N
+        isafe = jnp.minimum(idxs, n.n - 1)
+        avail_rows = (free_new + n.releasing + extra)[isafe]  # [K, R]
+        c_new = jnp.where(fp_build[:, isafe],
+                          _replicas_at(avail_rows), 0)        # [Y, K]
+        c_new = jnp.where((idxs < n.n)[None, :], c_new, 0)
+        # per-node delta via a junk-columned scratch: duplicates carry
+        # the SAME c_new (same node), so .set is deterministic
+        c_at = jnp.zeros((Y, n.n + 1), jnp.int32).at[:, idxs].set(c_new)
+        touched = jnp.zeros((n.n + 1,), bool).at[idxs].set(True)
+        d_node = jnp.where(touched[None, :], c_at - c_y, 0)   # [Y, N+1]
+        c_y = jnp.where(touched[None, :], c_at, c_y)
+        # accel delta per node: one replica consumes its type's accel —
+        # exact for the aggregate regardless of type mix
+        ty = g.task_type[jnp.minimum(cand, G - 1), 0]         # [B]
+        req0 = g.type_req[ty, 0]                              # [B]
+        accel = jnp.where(placed,
+                          jnp.broadcast_to(req0[:, None], (B_, T_)),
+                          0.0).ravel()
+        for lvl in range(L):
+            dom_caps_y = dom_caps_y.at[:, dom_of[lvl]].add(
+                d_node[:, :n.n], mode="drop")
+            dom = jnp.where(idxs < n.n, dom_of[lvl][isafe], ND)
+            agg = agg.at[jnp.minimum(dom, ND - 1)].add(
+                jnp.where(dom < ND, -accel, 0.0))
+        return dom_caps_y, agg, c_y
+
+    # in-cycle exclusion-term tracking (config.anti_groups): dense
     # domain id per (node, level) with per-node slots appended for the
-    # hostname granularity; AD+1 = junk slot
+    # hostname granularity; AD+1 = junk slot (see anti_domain_tables)
     AD = ND + n.n
-    AGP = max(1, config.num_anti_groups)
     if config.anti_groups:
-        node_slot = ND + jnp.arange(n.n)
-
-        def lane_dom_ids(lvl):
-            """[N] dense domain id at this gang's anti level.  Nodes
-            LACKING the level's label are their own per-node domain
-            (upstream anti-affinity treats a missing topology key as
-            no shared domain → no conflict); only padded node slots
-            map to the junk id AD."""
-            by_level = n.topology[:, jnp.clip(lvl, 0, L - 1)]
-            ids = jnp.where((lvl >= 0) & (lvl < L),
-                            jnp.where(by_level >= 0, by_level, node_slot),
-                            jnp.where(lvl >= L, node_slot, AD))
-            return jnp.where(n.valid, ids, AD)
+        dom_static, TA = anti_domain_tables(state)
 
     def attempt_one(gi, lane, prior, quota, dmask, free, dev, qa, qan,
                     ext, topo_tables):
@@ -1108,7 +1259,8 @@ def allocate(
 
     def chunk(carry):
         res, remaining, q_attempts, failed_sig, fuel = carry[:5]
-        anti_used = carry[5] if config.anti_groups else None
+        if hoist_topo:
+            dom_caps_y, dom_agg, c_y_store = carry[5:8]
         free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
                               res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
@@ -1118,19 +1270,35 @@ def allocate(
             # under-fs queue sorts strictly first and its (re-pushed)
             # jobs drain before an over-fs queue is popped at all, so
             # contested capacity goes to under-fs queues first.
-            over_fs = ordering.queue_order_keys(
-                q, qa, fair_share, total)[0] > 0.5                # [Q]
-            elig = remaining & ~over_fs[g.queue]
+            over_fs, over_quota, neg_prio, dom_share = \
+                ordering.queue_order_keys(q, qa, fair_share, total)
+            elig = remaining & (over_fs[g.queue] < 0.5)
             elig = jnp.where(jnp.any(elig), elig, remaining)
-            order = ordering.job_order_perm(
-                g, q, qa, fair_share, total, elig)
+            # dense lexicographic rank of each queue's live key tuple,
+            # via [Q, Q] pairwise strict-less (sort-free — Q is small):
+            # EQUAL-key queues share a rank, so their gangs interleave
+            # by the static job keys exactly as the full lexsort would
+            def _lt(a, b):
+                return a[:, None] < b[None, :]
+
+            def _eq(a, b):
+                return a[:, None] == b[None, :]
+
+            less = (_lt(over_fs, over_fs)
+                    | (_eq(over_fs, over_fs)
+                       & (_lt(over_quota, over_quota)
+                          | (_eq(over_quota, over_quota)
+                             & (_lt(neg_prio, neg_prio)
+                                | (_eq(neg_prio, neg_prio)
+                                   & _lt(dom_share, dom_share)))))))
+            qrank = jnp.sum(less.astype(jnp.int32), axis=0)       # [Q]
+            composite = (static_job_rank + qrank[gq_idx] * G
+                         + jnp.where(elig, 0, 2 * q.q * G))
         else:
-            # frozen keys, retired gangs pushed last (last lexsort key is
-            # most significant)
+            # frozen keys, retired gangs pushed last
             elig = remaining
-            order = jnp.lexsort(
-                (static_rank, (~remaining).astype(jnp.float32)))
-        cand = order[:B]                                          # [B]
+            composite = static_rank + jnp.where(remaining, 0, 2 * G)
+        cand = jnp.argsort(composite)[:B]                         # [B]
         cand_valid = elig[cand]
         if config.queue_depth is not None:
             # per-queue attempt budget (ref QueueDepthPerAction): a
@@ -1162,23 +1330,22 @@ def allocate(
         # instead of colliding on one
         lanes = jnp.arange(B, dtype=jnp.int32)
         ext = res.extended_free
-        tables = topo_tables_for(free, dev, qa) if hoist_topo else None
+        if hoist_topo:
+            # live caps (incrementally maintained), live fullest-first
+            # order (one single-key argsort per chunk)
+            order_by_agg = jnp.argsort(
+                jnp.where(level_of_dom >= 0, dom_agg, jnp.inf))
+            tables = (dom_caps_y, level_of_dom, order_by_agg)
+        else:
+            tables = None
         if config.anti_groups:
-            # lanes of an anti group may not use domains the group
-            # already claimed in earlier chunks...
-            ag_b = g.anti_group[cand]                             # [B]
-            lvl_b = g.anti_self_level[cand]
-            dom_ids_b = jax.vmap(lane_dom_ids)(lvl_b)             # [B, N]
-            forbid_b = (ag_b >= 0)[:, None] & anti_used[
-                jnp.maximum(ag_b, 0), dom_ids_b]
-            dmask_b = ~forbid_b                                   # [B, N]
-            # ... and only ONE lane per group may land per chunk (the
-            # rest conflict-retry with the updated table)
-            same = ((ag_b[None, :] == ag_b[:, None])
-                    & (ag_b >= 0)[None, :]
-                    & (jnp.arange(B)[None, :] < jnp.arange(B)[:, None]))
-            dup_b = jnp.any(same & cand_valid[None, :], axis=1) \
-                & cand_valid
+            # a lane may not use domains already claimed in any of its
+            # avoid rows, and only one side of a conflicting pair may
+            # land per chunk (the rest conflict-retry against the
+            # updated table)
+            dmask_b = ~anti_forbid_nodes(state, res.anti_used,
+                                         dom_static, cand)       # [B, N]
+            dup_b = anti_defer_lanes(state, cand, cand_valid)
         else:
             dmask_b = jnp.ones((B, n.n), bool)
             dup_b = jnp.zeros((B,), bool)
@@ -1316,19 +1483,17 @@ def allocate(
             res = res.replace(
                 fit_reason=jnp.where(skip_now, 2, res.fit_reason))
             remaining = remaining & ~skip_now
-        out = (res, remaining, q_attempts, failed_sig, fuel - 1)
         if config.anti_groups:
-            # taken lanes claim their placements' domains for the group;
-            # unmarked slots scatter into the JUNK ROW (index AGP) —
-            # never into a real group's row at the junk column, which
-            # doubles as a real per-node id for unlabeled nodes
-            mark = (take & (ag_b >= 0))[:, None] & (nodes_b >= 0)  # [B, T]
-            doms_t = jnp.take_along_axis(
-                dom_ids_b, jnp.maximum(nodes_b, 0), axis=1)        # [B, T]
-            rows = jnp.where(mark, jnp.maximum(ag_b, 0)[:, None], AGP)
-            anti_used = anti_used.at[
-                rows, jnp.where(mark, doms_t, AD)].max(True)
-            out = out + (anti_used,)
+            # taken lanes claim their placements' domains in their mark
+            # rows (junk row/column absorb unused slots)
+            res = res.replace(anti_used=anti_mark_placements(
+                state, res.anti_used, dom_static, cand, nodes_b, take))
+        out = (res, remaining, q_attempts, failed_sig, fuel - 1)
+        if hoist_topo:
+            dom_caps_y, dom_agg, c_y_store = topo_tables_update(
+                dom_caps_y, dom_agg, c_y_store, res.free,
+                take, cand, nodes_b)
+            out = out + (dom_caps_y, dom_agg, c_y_store)
         return out
 
     # fuel: every chunk either retires ≥1 remaining gang (the first
@@ -1338,9 +1503,8 @@ def allocate(
     # case is ceil(G/B) + elastic re-pushes + a few conflicts.
     carry0 = (init, remaining0, jnp.zeros((q.q,), jnp.int32),
               jnp.zeros((G,), bool), jnp.asarray(G * (T + 1), jnp.int32))
-    if config.anti_groups:
-        # row AGP is the junk write row (see the commit scatter)
-        carry0 = carry0 + (jnp.zeros((AGP + 1, AD + 1), bool),)
+    if hoist_topo:
+        carry0 = carry0 + topo_tables_build(init.free)
     out = lax.while_loop(cond, chunk, carry0)
     return out[0]
 

@@ -63,7 +63,9 @@ from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
-                       _attempt_gang, _chain_membership, init_result)
+                       _attempt_gang, _chain_membership, anti_defer_lanes,
+                       anti_domain_tables, anti_forbid_nodes,
+                       anti_mark_placements, init_result)
 from .scoring import W_OWN_FREED
 
 EPS = 1e-6
@@ -360,6 +362,7 @@ def solve_for_preemptor(
     config: VictimConfig,
     statics=None,                # hoisted victim_statics output
     job_rank: jax.Array | None = None,   # hoisted frozen_job_rank
+    domain_mask: jax.Array | None = None,   # bool [N] in-cycle anti mask
 ):
     """One preemptor's scenario search — returns updated commit-set fields.
 
@@ -388,9 +391,13 @@ def solve_for_preemptor(
         _ancestor_gate(q.parent, queue, num_levels, qan, q.quota, total_req),
         True)
     if reclaim:
-        # CanReclaimResources: stay within fair share along the chain
-        gate = _ancestor_gate(q.parent, queue, num_levels, qa,
-                              fair_share, total_req) & nonpreempt_quota_ok
+        # CanReclaimResources: the chain stays within fair share in the
+        # POST-SCENARIO state (victims' releases credited) — checked per
+        # attempt below against qa_eff, NOT against live qa: a dept at
+        # its full fair share must still be able to reclaim WITHIN
+        # itself (same-dept victims free the very allocation the
+        # reclaimer adds)
+        gate = nonpreempt_quota_ok
     elif consolidate:
         # consolidation only serves pending *preemptible* jobs
         # (``consolidation.go`` pending-preemptible filter)
@@ -491,7 +498,12 @@ def solve_for_preemptor(
                           num_levels, alloc_cfg, extra_eff,
                           extra_dev_eff, chain=chain,
                           ext_free=result.extended_free,
-                          extra_extended_releasing=ext_extra_eff)
+                          extra_extended_releasing=ext_extra_eff,
+                          domain_mask=domain_mask)
+        if reclaim:
+            # CanReclaimResources against the post-scenario state
+            success &= _ancestor_gate(q.parent, queue, num_levels,
+                                      qa_eff, fair_share, total_req)
         if consolidate:
             free3, dev3, moves, all_ok = _replace_victims(
                 state, mask_k, free2, dev2, n.releasing + extra_eff,
@@ -810,6 +822,9 @@ def _run_victim_action_chunked(
         mrt_g = q.preempt_min_runtime_eff[gq]
         protected = (gang_runtime >= 0) & (gang_runtime < mrt_g)
     gang_prio_pod = g.priority[jnp.maximum(r.gang, 0)]          # [M]
+    anti = pcfg.anti_groups
+    if anti:
+        dom_static, _TA = anti_domain_tables(state)
 
     # ---- hoisted: frozen eviction-unit order + per-unit tables ----------
     cand0 = base0 & ~result.victim                               # [M]
@@ -984,11 +999,6 @@ def _run_victim_action_chunked(
                 q.parent, qi, num_levels, qan, q.quota, tr))(
                     q_b, lane_req)
         gate_b = jnp.where(nonpre_b, gate_np_b, True)
-        if reclaim:
-            gate_b &= jax.vmap(
-                lambda qi, tr: _ancestor_gate(
-                    q.parent, qi, num_levels, qa, fair_share, tr))(
-                        q_b, lane_req)
         gate_b &= cand_valid & (K_raw <= hi_b) & ~insufficient_b
 
         # ---- pod → lane assignment + per-lane freed pools ---------------
@@ -1016,16 +1026,35 @@ def _run_victim_action_chunked(
         extra_dev_b = extra_dev[None] + freed_d_b
         ext_extra_b = ext_extra[None] + freed_e_b
         qa_eff_b = qa[None] - freed_q_b                          # [B, Q, R]
+        if reclaim:
+            # CanReclaimResources against the POST-SCENARIO state (the
+            # lane's own victim credit applied): a dept at its full fair
+            # share can still reclaim within itself
+            gate_b &= jax.vmap(
+                lambda qi, tr, qae: _ancestor_gate(
+                    q.parent, qi, num_levels, qae, fair_share, tr))(
+                        q_b, lane_req, qa_eff_b)
         bias_b = W_OWN_FREED * own_incr_b.astype(jnp.float32)    # [B, N]
+        if anti:
+            dmask_b = ~anti_forbid_nodes(state, res.anti_used,
+                                         dom_static, cand_g)     # [B, N]
+            dup_b = anti_defer_lanes(state, cand_g, cand_valid)
+        else:
+            dmask_b = jnp.ones((B, n.n), bool)
+            dup_b = jnp.zeros((B,), bool)
         (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
          bind_b, devbind_b, ext2_b, extbind_b) = jax.vmap(
-            lambda gi, lane, ex_n, ex_d, ex_e, qae, sb: _attempt_gang(
+            lambda gi, lane, ex_n, ex_d, ex_e, qae, sb, dm: _attempt_gang(
                 state, gi, free, dev, qae, qan, num_levels, pcfg,
                 ex_n, ex_d, lane, chain, ext_free=ext,
-                extra_extended_releasing=ex_e, score_bias=sb))(
+                extra_extended_releasing=ex_e, score_bias=sb,
+                domain_mask=dm))(
             cand_g, lanes, extra_b, extra_dev_b, ext_extra_b, qa_eff_b,
-            bias_b)
+            bias_b, dmask_b)
 
+        # an anti-deferred lane is CONFLICT-rejected (retries next chunk
+        # against the updated claimed-domain table), never terminal
+        succ_b = succ_b & ~dup_b
         ok_pre = gate_b & succ_b                                 # [B]
         okm = ok_pre[:, None, None]
         d_free = jnp.where(okm, free[None] - free2_b, 0.0)
@@ -1096,7 +1125,7 @@ def _run_victim_action_chunked(
         # If you add an accept-ONLY check, also gate it in gate_b, or
         # the loop can spin identical chunks until fuel exhausts.
         first_bad = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
-        first_fail = first_bad & ~ok_pre
+        first_fail = first_bad & ~ok_pre & ~dup_b
         any_take = jnp.any(take)
         star = jnp.argmax(jnp.where(take, lanes, -1))
         victims = (lane_of_pod <= star) & any_take
@@ -1140,6 +1169,10 @@ def _run_victim_action_chunked(
                 jnp.where(first_fail, 3, res.fit_reason[cand_g])),
             victim=res.victim | victims,
         )
+        if anti:
+            res = res.replace(anti_used=anti_mark_placements(
+                state, res.anti_used, dom_static, cand_g,
+                jnp.where(take[:, None], nodes_b, -1), take))
         done_b = take | first_fail
         remaining = remaining.at[cand_g].set(
             remaining[cand_g] & ~done_b)
@@ -1214,6 +1247,9 @@ def run_victim_action(
     statics = victim_statics(state)
     job_rank0 = frozen_job_rank(state, result.queue_allocated, fair_share)
     quota_eff_q = jnp.where(q.quota <= UNLIMITED + 0.5, jnp.inf, q.quota)
+    anti = config.placement.anti_groups
+    if anti:
+        dom_static, _TA = anti_domain_tables(state)
     if mode == "reclaim":
         # [victim leaf, reclaimer leaf] leveled-queue table for the live
         # strategy-viability drop inside `step`
@@ -1228,11 +1264,14 @@ def run_victim_action(
         runnable = remaining[gi] & g.valid[gi] & (g.backoff[gi] <= 0) \
             & ~res.allocated[gi]
 
+        dmask = (~anti_forbid_nodes(state, res.anti_used, dom_static, gi)
+                 if anti else None)
+
         def attempt(_):
             return solve_for_preemptor(
                 state, gi, res, fair_share, chain,
                 num_levels=num_levels, mode=mode, config=config,
-                statics=statics, job_rank=job_rank0)
+                statics=statics, job_rank=job_rank0, domain_mask=dmask)
 
         def skip(_):
             T = g.t
@@ -1274,6 +1313,12 @@ def run_victim_action(
             victim_move=jnp.where(success & (moves >= 0), moves,
                                   res.victim_move),
         )
+        if anti:
+            # a victim-action placement claims its domains too, so a
+            # later conflicting gang (in this or a later action of the
+            # cycle) cannot co-land with a reclaim-placed preemptor
+            res = res.replace(anti_used=anti_mark_placements(
+                state, res.anti_used, dom_static, gi, nodes_t, success))
         remaining = remaining.at[gi].set(False)
         if depth is not None:
             # per-QUEUE attempt budget (ref QueueDepthPerAction: "max

@@ -205,11 +205,23 @@ class GangState(struct.PyTreeNode):
     #: gang-internal anti-affinity: tasks of this gang may not share a
     #: topology domain at this level (L = per-node, -1 = none)
     anti_self_level: jax.Array    # i32 [G]
-    #: cross-gang anti group: gangs sharing an id carry the SAME
-    #: required anti term matching each other's pods — no two of their
-    #: pods may share a domain at anti_self_level, across gangs (-1 =
-    #: none); see the allocate wavefront's anti-domain tracking
-    anti_group: jax.Array         # i32 [G]
+    #: IN-CYCLE exclusion terms (the tensorization of InterPodAffinity /
+    #: NodePorts over virtually-allocated session state): a term is a
+    #: row of the cycle's claimed-domain table (AllocationResult
+    #: ``anti_used``).  When a gang with ``anti_marks`` slots places, it
+    #: claims its nodes' domains (at each term's level) in those rows; a
+    #: gang may never place into a domain claimed in any of its
+    #: ``anti_avoids`` rows.  Three term kinds share the machinery:
+    #: SYMMETRIC rows (mutual required anti-affinity — members mark and
+    #: avoid), FORWARD/REVERSE row pairs (asymmetric required anti:
+    #: label-matchers mark fwd / carriers avoid fwd, carriers mark rev /
+    #: matchers avoid rev), and PORT rows (pending pods sharing a host
+    #: port — carriers mark and avoid at per-node granularity).
+    #: -1 = unused slot; term ids index ``anti_term_level``.
+    anti_marks: jax.Array         # i32 [G, KT]
+    anti_avoids: jax.Array        # i32 [G, KT]
+    #: topology level per term row (num_topo_levels = per-node)
+    anti_term_level: jax.Array    # i32 [TA]
 
     @property
     def g(self) -> int:
@@ -280,6 +292,12 @@ class ClusterState(struct.PyTreeNode):
 # Padding helpers
 # ---------------------------------------------------------------------------
 
+#: in-cycle exclusion term slots per gang (marks/avoids each); terms
+#: beyond the cap fall back to next-cycle convergence via the filter
+#: masks (documented staleness, bounded and deterministic)
+ANTI_SLOTS = 4
+
+
 def _round_up(n: int, multiple: int = 8) -> int:
     """Pad sizes to multiples so capacity growth rarely recompiles."""
     if n <= 0:
@@ -326,10 +344,13 @@ class SnapshotIndex:
     #: reclaimer) LCA tables are lane-dependent, so the chunked victim
     #: path must stay off (see VictimConfig.chunk_reclaim)
     has_reclaim_minruntime: bool = False
-    #: >=2 pending gangs share a cross-gang anti group (mutual required
-    #: anti-affinity): the allocate wavefront tracks their claimed
-    #: domains in-cycle (AllocateConfig.anti_groups)
+    #: the snapshot emitted in-cycle exclusion term rows (mutual or
+    #: asymmetric required anti-affinity between pending gangs, or a
+    #: host port shared by >=2 pending gangs): the placement wavefronts
+    #: track their claimed domains in-cycle (AllocateConfig.anti_groups)
     has_anti_groups: bool = False
+    #: emitted term-row count (the anti_used table's row dimension is
+    #: sized from the state arrays; this is informational)
     num_anti_groups: int = 0
     #: host (numpy) copies of the snapshot-side tables the commit path
     #: reads — kept so cycle results never transfer them back from the
@@ -610,7 +631,8 @@ def build_snapshot(
         task_filter_class=np.zeros((G, T), np.int32),
         task_nominated=np.full((G, T), -1, np.int32),
         anti_self_level=np.full((G,), -1, np.int32),
-        anti_group=np.full((G,), -1, np.int32),
+        anti_marks=np.full((G, ANTI_SLOTS), -1, np.int32),
+        anti_avoids=np.full((G, ANTI_SLOTS), -1, np.int32),
         task_type=np.zeros((G, T), np.int32),
         sig=np.zeros((G,), np.int32),
         task_extended=np.zeros((G, T, E), np.float32),
@@ -674,13 +696,21 @@ def build_snapshot(
                     items.extend(sorted(sc.allowed_topology.items()))
         return tuple(items)
 
+    #: label keys any running pod's required anti selector mentions —
+    #: incoming pods carrying them need the reverse-anti evaluation
+    rev_keys = node_filters.reverse_anti_keys(running_pods)
+
     def filter_class_of(pod: apis.Pod, dra_key: tuple = ()) -> int:
+        rev_labels = tuple(sorted(
+            (k, v) for k, v in pod.labels.items() if k in rev_keys))
         # fast path: the overwhelming majority of pods carry no filter
         # spec at all — class 0 without building the canonical key
         if not (pod.tolerations or pod.node_affinity or pod.pod_affinity
-                or dra_key or pod.volume_claims or pod.host_ports):
+                or dra_key or pod.volume_claims or pod.host_ports
+                or rev_labels):
             return 0
-        key = node_filters.pod_filter_spec(pod, dra_key, vol_of(pod))
+        key = node_filters.pod_filter_spec(pod, dra_key, vol_of(pod),
+                                           rev_labels)
         if key not in spec_index:
             spec_index[key] = len(filter_specs)
             filter_specs.append(key)
@@ -739,6 +769,7 @@ def build_snapshot(
         (len(pending_by_group[g.name]) for g in pod_groups), np.int64,
         len(pod_groups)) if pod_groups else np.zeros((0,), np.int64)
     nf = len(all_pend)
+    anti_term_level = np.zeros((0,), np.int32)
     task_type_index: dict[tuple, int] = {}
     if nf:
         gidx = np.repeat(np.arange(len(pod_groups)), counts)
@@ -832,25 +863,119 @@ def build_snapshot(
             gk["task_subgroup"][gi_a, ti_a] = subcol[order]
         paff = np.fromiter((bool(p.pod_affinity) for p in all_pend), bool,
                            nf)
-        anti_vocab: dict[tuple, int] = {}
-        gang_anti_key: dict[int, tuple] = {}
+        # gang-internal spread level (self-selecting required anti term)
         for j in np.nonzero(paff)[0].tolist():
-            asl, akey = node_filters.anti_self_term(all_pend[j],
-                                                    topo_levels, L)
+            asl, _ = node_filters.anti_self_term(all_pend[j],
+                                                 topo_levels, L)
             if asl >= 0:
                 i = gidx[j]
                 cur = gk["anti_self_level"][i]
-                # the group id must track the WINNING (coarsest) level —
-                # its dense domain-id space is level-specific, so a
-                # mismatched (group, level) pair would never collide
-                # with its peers' marks
-                if cur < 0 or asl < cur or (asl == cur
-                                            and akey < gang_anti_key[i]):
-                    gang_anti_key[i] = akey
-                    gk["anti_group"][i] = anti_vocab.setdefault(
-                        akey, len(anti_vocab))
                 gk["anti_self_level"][i] = (asl if cur < 0
                                             else min(cur, asl))
+        # in-cycle exclusion terms (see GangState.anti_marks): collect
+        # each gang's required anti terms + label dicts, then emit
+        # symmetric rows / forward+reverse row pairs / port rows
+        terms_by_gang: dict[int, set] = {}
+        for j in np.nonzero(paff)[0].tolist():
+            i = gidx[j]
+            for term in all_pend[j].pod_affinity:
+                if term.required and term.anti:
+                    lvl = (topo_levels.index(term.topology_key)
+                           if term.topology_key in topo_levels else L)
+                    terms_by_gang.setdefault(i, set()).add(
+                        (term.match_labels, lvl))
+        ports_by_gang: dict[int, set] = {}
+        port_counts: dict[int, dict] = {}
+        for j, p in enumerate(all_pend):
+            if p.host_ports:
+                i = gidx[j]
+                ports_by_gang.setdefault(i, set()).update(p.host_ports)
+                cnts = port_counts.setdefault(i, {})
+                for prt in set(p.host_ports):
+                    cnts[prt] = cnts.get(prt, 0) + 1
+        for i, cnts in port_counts.items():
+            # replicas SHARING a port can never share a node; a gang
+            # whose pods all use distinct ports co-locates freely.
+            # Granularity note: anti-self is gang-wide, so a gang mixing
+            # ported and portless pods over-spreads the portless ones —
+            # conservative (never an invalid co-placement), and exact
+            # for the dominant uniform-replica shape.
+            if any(c >= 2 for c in cnts.values()):
+                cur = gk["anti_self_level"][i]
+                gk["anti_self_level"][i] = L if cur < 0 else min(cur, L)
+        all_terms = sorted({t for s in terms_by_gang.values() for t in s})
+        if all_terms:
+            term_keys = {k for ml, _ in all_terms for k, _ in ml}
+            labels_by_gang: dict[int, list] = {}
+            for j, p in enumerate(all_pend):
+                if p.labels and term_keys & p.labels.keys():
+                    labels_by_gang.setdefault(gidx[j], [])
+                    if p.labels not in labels_by_gang[gidx[j]]:
+                        labels_by_gang[gidx[j]].append(p.labels)
+        rows: list[int] = []      # level per emitted row
+        marks_of: dict[int, list] = {}
+        avoids_of: dict[int, list] = {}
+
+        def _slot(d, i, row):
+            lst = d.setdefault(i, [])
+            if row not in lst and len(lst) < ANTI_SLOTS:
+                lst.append(row)
+
+        for ml, lvl in all_terms:
+            carriers = {i for i, ts in terms_by_gang.items()
+                        if (ml, lvl) in ts}
+            matchers = {i for i, lds in labels_by_gang.items()
+                        if any(all(ld.get(k) == v for k, v in ml)
+                               for ld in lds)}
+            if not matchers:
+                continue  # nobody to exclude — row would never be marked
+            if matchers == carriers:
+                row = len(rows)
+                rows.append(lvl)
+                for i in carriers:
+                    _slot(marks_of, i, row)
+                    _slot(avoids_of, i, row)
+            else:
+                fwd = len(rows)
+                rows.append(lvl)
+                rev = len(rows)
+                rows.append(lvl)
+                for i in matchers:
+                    _slot(marks_of, i, fwd)
+                    _slot(avoids_of, i, rev)
+                for i in carriers:
+                    _slot(avoids_of, i, fwd)
+                    _slot(marks_of, i, rev)
+        all_ports = sorted({p for s in ports_by_gang.values() for p in s})
+        for port in all_ports:
+            carriers = {i for i, ps in ports_by_gang.items() if port in ps}
+            if len(carriers) < 2:
+                continue  # single carrier: anti_self covers it
+            # Granularity note: marks claim ALL of a carrier gang's
+            # placement nodes, so a gang mixing ported and portless
+            # pods over-excludes the other carriers from its portless
+            # nodes for ONE cycle (next cycle the filter masks see the
+            # exact running ports) — conservative, never an invalid
+            # co-placement; exact for uniform-replica gangs.
+            row = len(rows)
+            rows.append(L)  # per-node
+            for i in carriers:
+                _slot(marks_of, i, row)
+                _slot(avoids_of, i, row)
+        for i, lst in marks_of.items():
+            gk["anti_marks"][i, :len(lst)] = lst
+        for i, lst in avoids_of.items():
+            gk["anti_avoids"][i, :len(lst)] = lst
+        # pad the row count to a power of two: anti_term_level's shape
+        # sizes the anti_used table, and AllocateConfig-keyed kernels
+        # recompile on every distinct shape — without padding a pending
+        # set whose term count drifts 3 -> 4 -> 3 across cycles would
+        # recompile every cycle.  Padded rows are never referenced (no
+        # gang's marks/avoids point at them).
+        if rows:
+            padded = 1 << max(0, len(rows) - 1).bit_length()
+            rows = rows + [0] * (padded - len(rows))
+        anti_term_level = np.asarray(rows, np.int32)
 
     # --- running pods -----------------------------------------------------
     # Pods whose node is missing from the snapshot (cordoned/deleted) keep
@@ -1149,9 +1274,13 @@ def build_snapshot(
 
     # --- evaluate filter classes against nodes (host, once per spec) ------
     running_views = [
-        node_filters._RunningPodView(labels=pod.labels,
-                                     node=int(rk["node"][j]),
-                                     host_ports=tuple(pod.host_ports))
+        node_filters._RunningPodView(
+            labels=pod.labels,
+            node=int(rk["node"][j]),
+            host_ports=tuple(pod.host_ports),
+            anti_terms=tuple(
+                (t.match_labels, t.topology_key)
+                for t in pod.pod_affinity if t.required and t.anti))
         for j, pod in enumerate(running_pods)
         if pod.status != apis.PodStatus.RELEASING]
     filter_masks, soft_scores = node_filters.evaluate_filter_classes(
@@ -1225,7 +1354,7 @@ def build_snapshot(
             preempt_min_runtime_eff=_f(np.asarray(q_preempt_eff, dtype)),
             reclaim_min_runtime_eff=_f(np.asarray(q_reclaim_eff, dtype)),
         ),
-        gangs=GangState(**gk),
+        gangs=GangState(**gk, anti_term_level=anti_term_level),
         running=RunningState(**rk),
     )
     state = jax.device_put(state)
@@ -1246,10 +1375,8 @@ def build_snapshot(
         has_extended_resources=bool(ext_keys),
         extended_keys=ext_keys,
         has_reclaim_minruntime=bool((q_reclaim_mrt > 0).any()),
-        has_anti_groups=bool(
-            len(np.unique(gk["anti_group"][gk["anti_group"] >= 0]))
-            < (gk["anti_group"] >= 0).sum()),
-        num_anti_groups=int(gk["anti_group"].max(initial=-1)) + 1,
+        has_anti_groups=len(anti_term_level) > 0,
+        num_anti_groups=len(anti_term_level),
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

@@ -26,21 +26,23 @@ still excludes nodes with untolerated hard taints, which is what keeps
 plain pods off control-plane/maintenance nodes.
 
 IN-CYCLE AFFINITY SEMANTICS: required (anti-)affinity vs RUNNING pods
-is evaluated here at snapshot build.  MUTUAL required anti-affinity
-between gangs (both sides' terms select each other's labels — the
-"one db per node/rack" pattern) is ALSO enforced within a cycle when
-the gangs' WINNING (coarsest) self-anti terms coincide: such gangs
-share an anti GROUP (``GangState.anti_group``) and the allocate
-wavefront tracks the domains each group has claimed, so two of them
-cannot land in one domain even in the same chunk (see
-``AllocateConfig.anti_groups``; one group slot per gang — pairs that
-share only a non-winning term fall back to next-cycle convergence).  What remains snapshot-stale for one
-cycle: ASYMMETRIC required affinity/anti-affinity toward another gang
-placed in the same cycle, NodePorts conflicts between two pending
-pods, and preemptors placed by the VICTIM actions (reclaim/preempt
-place one gang at a time without the allocate wavefront's anti-domain
-table) — all converge next cycle when the first placement shows up as
-running.
+is evaluated here at snapshot build — BOTH directions: the incoming
+pod's own terms against running pods, and running pods' required anti
+terms against the incoming pod's labels (upstream InterPodAffinity's
+existing-pod check), the latter via the ``reverse_labels`` component
+of the spec key.  Required anti-affinity BETWEEN gangs placed in the
+SAME cycle — mutual ("one db per node/rack"), asymmetric (only one
+side carries the term; forward and reverse), and NodePorts conflicts
+between two pending pods — is enforced in-cycle through the
+exclusion-term rows the snapshot emits (``GangState.anti_marks`` /
+``anti_avoids``) and the cycle's claimed-domain table
+(``AllocationResult.anti_used``), which ALL placement actions honour:
+the allocate wavefront and the victim actions' placements alike (see
+``AllocateConfig.anti_groups``).  What remains snapshot-stale for one
+cycle: asymmetric required POSITIVE affinity toward a gang placed in
+the same cycle (the depender fails its feasibility prefilter and
+converges next cycle — conservative, never a constraint violation),
+and gangs whose term count exceeds the ``ANTI_SLOTS`` cap.
 """
 from __future__ import annotations
 
@@ -58,7 +60,8 @@ _HARD_EFFECTS = ("NoSchedule", "NoExecute")
 
 
 def pod_filter_spec(pod: apis.Pod, dra: tuple = (),
-                    volume: tuple = ()) -> tuple:
+                    volume: tuple = (),
+                    reverse_labels: tuple = ()) -> tuple:
     """Canonical hashable key of a pod's node-filter spec.
 
     ``dra`` carries the pod's resolved DeviceClass constraints —
@@ -67,7 +70,11 @@ def pod_filter_spec(pod: apis.Pod, dra: tuple = (),
     unbound classes' allowedTopologies), so DRA and storage node
     selection (ref ``plugins/dynamicresources`` and the VolumeBinding
     predicate) ride the same vocabulary.  ``host_ports`` feed the
-    NodePorts predicate.
+    NodePorts predicate.  ``reverse_labels`` is the pod's label subset
+    that any RUNNING pod's required anti-affinity selector could match
+    (upstream InterPodAffinity also enforces EXISTING pods' anti terms
+    against the incoming pod — the "reverse" direction); restricting to
+    the keys those selectors mention keeps the vocabulary small.
     """
     aff = tuple(sorted(
         (e.key, e.operator, tuple(e.values)) for e in pod.node_affinity))
@@ -77,10 +84,11 @@ def pod_filter_spec(pod: apis.Pod, dra: tuple = (),
     pa = tuple(sorted(
         (term.match_labels, term.topology_key, term.anti, term.required)
         for term in pod.pod_affinity))
-    return (aff, tol, pa, dra, volume, tuple(sorted(pod.host_ports)))
+    return (aff, tol, pa, dra, volume, tuple(sorted(pod.host_ports)),
+            reverse_labels)
 
 
-EMPTY_SPEC = ((), (), (), (), (), ())
+EMPTY_SPEC = ((), (), (), (), (), (), ())
 
 
 @dataclasses.dataclass
@@ -91,6 +99,23 @@ class _RunningPodView:
     labels: dict[str, str]
     node: int  # snapshot node index, -1 unknown
     host_ports: tuple = ()
+    #: the pod's REQUIRED ANTI terms as (match_labels, topology_key) —
+    #: enforced in reverse against incoming pods (upstream
+    #: InterPodAffinity's existing-pod anti-affinity check)
+    anti_terms: tuple = ()
+
+
+def reverse_anti_keys(running_pods) -> frozenset:
+    """Label KEYS mentioned by any running pod's required anti-affinity
+    selector — the subset of an incoming pod's labels that can decide
+    the reverse InterPodAffinity check (everything else is irrelevant,
+    which keeps the filter-class vocabulary from growing per pod)."""
+    keys: set[str] = set()
+    for pod in running_pods:
+        for term in pod.pod_affinity:
+            if term.required and term.anti:
+                keys.update(k for k, _ in term.match_labels)
+    return frozenset(keys)
 
 
 def _domain_ids(node_topo: np.ndarray, topo_levels: list[str],
@@ -125,6 +150,21 @@ def evaluate_filter_classes(
     for rp in running:
         if rp.node >= 0 and rp.host_ports:
             used_ports.setdefault(rp.node, set()).update(rp.host_ports)
+    # reverse-anti exclusion masks, hoisted: per distinct running-side
+    # required anti term, the nodes whose domain hosts a carrier — an
+    # incoming pod matching the selector is excluded from them (one [N]
+    # mask per term instead of a domain rebuild per spec × running pod)
+    rev_excl: dict[tuple, np.ndarray] = {}
+    for rv in running:
+        if rv.node < 0:
+            continue
+        for ml, tkey in rv.anti_terms:
+            doms = _domain_ids(node_topo, topo_levels, tkey, N)
+            d = doms[rv.node]
+            if d < 0:
+                continue
+            cur = rev_excl.setdefault((ml, tkey), np.zeros((N,), bool))
+            cur |= doms == d
 
     for xi, spec in enumerate(specs):
         pod = pods_by_spec[spec]
@@ -173,6 +213,14 @@ def evaluate_filter_classes(
             for ni in range(N):
                 if mask[ni] and want & used_ports.get(ni, set()):
                     mask[ni] = False
+        # --- REVERSE required anti-affinity: a running pod's own anti
+        # term excludes incoming pods matching its selector from its
+        # domain (upstream InterPodAffinity's existingAntiAffinity check)
+        if len(spec) > 6 and spec[6]:
+            own_labels = dict(spec[6])
+            for (ml, _tkey), excl in rev_excl.items():
+                if all(own_labels.get(k) == v for k, v in ml):
+                    mask &= ~excl
         # --- inter-pod (anti-)affinity (upstream InterPodAffinity) -------
         pref_aff = np.zeros((N,), np.float32)
         for term_key in spec[2]:

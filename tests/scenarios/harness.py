@@ -1,0 +1,226 @@
+"""Table-driven scenario harness — the parity analogue of the
+reference's per-feature test catalogs.
+
+Each :class:`Case` is one named scenario traceable to a reference test
+(``ref`` carries the reference file and test-case name, e.g.
+``allocateGang_test.go: "Allocate train gang job"``).  A case builds a
+synthetic cluster from terse specs, runs ONE full scheduler cycle
+(snapshot → default action pipeline → commit), and asserts the
+reference-matching outcome: which gangs placed (and optionally where /
+how many tasks), which stayed pending, how many victims were evicted,
+and what got pipelined.
+
+The specs are intentionally tiny — a catalog of dozens of cases must
+read like the reference's declarative TestTopologyData tables, not like
+setup code.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.framework.scheduler import Scheduler
+from kai_scheduler_tpu.runtime.cluster import Cluster
+
+
+@dataclasses.dataclass
+class N:
+    """Node spec."""
+
+    name: str
+    gpu: float = 8.0
+    cpu: float = 64.0
+    mem: float = 256.0
+    gpu_mem_gib: float = 0.0          # per-device memory (memory-based shares)
+    labels: dict = dataclasses.field(default_factory=dict)
+    taints: list = dataclasses.field(default_factory=list)
+    mig: dict = dataclasses.field(default_factory=dict)  # extended resources
+
+
+@dataclasses.dataclass
+class Q:
+    """Leaf queue spec (a single shared department is implied unless
+    ``parent`` names another spec'd queue)."""
+
+    name: str
+    quota: float = -1.0               # UNLIMITED by default
+    limit: float = -1.0
+    priority: int = 0
+    parent: str | None = None
+    preempt_min_runtime: float = 0.0
+    reclaim_min_runtime: float = 0.0
+
+
+@dataclasses.dataclass
+class G:
+    """Gang spec: ``tasks`` pending pods of ``gpu`` each; ``on`` makes
+    it RUNNING instead, round-robin over the listed nodes."""
+
+    name: str
+    queue: str = "q0"
+    tasks: int = 1
+    gpu: float = 1.0
+    cpu: float = 1.0
+    mem: float = 4.0
+    min_member: int = 0               # 0 = tasks (whole gang)
+    priority: int = 0
+    on: list | None = None            # running placements (node names)
+    portion: float = 0.0              # fractional share per task
+    gpu_mem: float = 0.0              # memory-based share per task (GiB)
+    mig: dict = dataclasses.field(default_factory=dict)
+    labels: dict = dataclasses.field(default_factory=dict)
+    affinity: list = dataclasses.field(default_factory=list)
+    preemptible: bool = True
+    runtime_s: float = 3600.0         # running pods' age
+    subgroups: list = dataclasses.field(default_factory=list)
+    subgroup_of: list | None = None   # per-task subgroup names
+    topology: tuple | None = None     # (required_level, preferred_level)
+    devices: list | None = None       # running pods' device ids (fractions)
+
+
+@dataclasses.dataclass
+class Case:
+    """One scenario: build → one cycle → assert."""
+
+    name: str
+    ref: str                          # reference file + case name
+    nodes: list = dataclasses.field(default_factory=list)
+    queues: list = dataclasses.field(default_factory=list)
+    gangs: list = dataclasses.field(default_factory=list)
+    topology_levels: list = dataclasses.field(default_factory=list)
+    #: gang -> expected PLACED task count (0 = must stay pending);
+    #: True = all tasks placed
+    expect: dict = dataclasses.field(default_factory=dict)
+    #: gang -> set of allowed node names (all its placements inside)
+    expect_nodes: dict = dataclasses.field(default_factory=dict)
+    #: exact victim (eviction) count; None = don't check
+    expect_evictions: int | None = None
+    #: gang -> minimum pipelined task count
+    expect_pipelined: dict = dataclasses.field(default_factory=dict)
+    #: pairs of gangs that must not share a node
+    expect_disjoint: list = dataclasses.field(default_factory=list)
+    #: pairs of gangs that MUST share at least one node/domain
+    expect_colocated: list = dataclasses.field(default_factory=list)
+
+
+def _build(case: Case):
+    nodes = []
+    for ns in case.nodes:
+        labels = {"kubernetes.io/hostname": ns.name, **ns.labels}
+        nodes.append(apis.Node(
+            name=ns.name,
+            allocatable=apis.ResourceVec(ns.gpu, ns.cpu, ns.mem),
+            labels=labels, taints=list(ns.taints),
+            accel_memory_gib=ns.gpu_mem_gib or 16.0,
+            extended=dict(ns.mig)))
+    specs = case.queues or [Q("q0")]
+    parents = {qs.parent for qs in specs if qs.parent}
+    queues = [apis.Queue(name=p) for p in sorted(parents)]
+    if not parents:
+        queues.append(apis.Queue(name="dept"))
+    for qs in specs:
+        queues.append(apis.Queue(
+            name=qs.name, parent=qs.parent or "dept",
+            priority=qs.priority,
+            accel=apis.QueueResource(quota=qs.quota, limit=qs.limit),
+            preempt_min_runtime=qs.preempt_min_runtime,
+            reclaim_min_runtime=qs.reclaim_min_runtime))
+    groups, pods = [], []
+    for gs in case.gangs:
+        running = gs.on is not None
+        sub_groups = [apis.SubGroup(name=nm, min_member=mm)
+                      for nm, mm in gs.subgroups]
+        topo = None
+        if gs.topology:
+            req, pref = gs.topology
+            topo = apis.TopologyConstraint(
+                topology="default", required_level=req,
+                preferred_level=pref)
+        groups.append(apis.PodGroup(
+            name=gs.name, queue=gs.queue,
+            min_member=gs.min_member or gs.tasks,
+            priority=gs.priority,
+            preemptibility=(apis.Preemptibility.PREEMPTIBLE
+                            if gs.preemptible
+                            else apis.Preemptibility.NON_PREEMPTIBLE),
+            last_start_timestamp=-gs.runtime_s if running else None,
+            sub_groups=sub_groups,
+            topology_constraint=topo))
+        for t in range(gs.tasks):
+            pod = apis.Pod(
+                name=f"{gs.name}-{t}", group=gs.name,
+                resources=apis.ResourceVec(gs.gpu, gs.cpu, gs.mem),
+                accel_portion=gs.portion,
+                accel_memory_gib=gs.gpu_mem,
+                labels=dict(gs.labels),
+                pod_affinity=list(gs.affinity),
+                extended=dict(gs.mig),
+                subgroup=(gs.subgroup_of[t]
+                          if gs.subgroup_of else None))
+            if running:
+                pod.status = apis.PodStatus.RUNNING
+                pod.node = gs.on[t % len(gs.on)]
+                if gs.devices:
+                    pod.accel_devices = [gs.devices[t % len(gs.devices)]]
+            pods.append(pod)
+    return Cluster.from_objects(nodes, queues, groups, pods,
+                                (apis.Topology(
+                                    name="default",
+                                    levels=(case.topology_levels
+                                            + ["kubernetes.io/hostname"]))
+                                 if case.topology_levels else None))
+
+
+def run_case(case: Case):
+    cluster = _build(case)
+    sched = Scheduler()
+    res = sched.run_once(cluster)
+    # gang -> (placed count, node names, pipelined count)
+    placed = {b.pod_name.rsplit("-", 1)[0]: [] for b in res.bind_requests}
+    for b in res.bind_requests:
+        placed[b.pod_name.rsplit("-", 1)[0]].append(b.selected_node)
+    pl = np.asarray(res.tensors.placements)
+    pipe = np.asarray(res.tensors.pipelined)
+    alloc = np.asarray(res.tensors.allocated)
+    gang_names = [gs.name for gs in case.gangs]
+    rows = {nm: i for i, nm in enumerate(gang_names)}
+    node_names = [ns.name for ns in case.nodes]
+
+    def placements_of(gang):
+        gi = rows[gang]
+        return [node_names[v] for v in pl[gi][pl[gi] >= 0]]
+
+    for gang, want in case.expect.items():
+        got = len(placements_of(gang)) if alloc[rows[gang]] else 0
+        total = next(gs.tasks for gs in case.gangs if gs.name == gang)
+        want_n = total if want is True else int(want)
+        assert got == want_n, (
+            f"{case.name}: {gang} placed {got} tasks, expected {want_n} "
+            f"(ref {case.ref})")
+    for gang, allowed in case.expect_nodes.items():
+        ns = set(placements_of(gang))
+        assert ns and ns <= set(allowed), (
+            f"{case.name}: {gang} on {ns}, allowed {allowed} "
+            f"(ref {case.ref})")
+    if case.expect_evictions is not None:
+        assert len(res.evictions) == case.expect_evictions, (
+            f"{case.name}: {len(res.evictions)} evictions, expected "
+            f"{case.expect_evictions} (ref {case.ref})")
+    for gang, minp in case.expect_pipelined.items():
+        got = int(pipe[rows[gang]].sum())
+        assert got >= minp, (
+            f"{case.name}: {gang} pipelined {got} < {minp} "
+            f"(ref {case.ref})")
+    for a, b in case.expect_disjoint:
+        na, nb = set(placements_of(a)), set(placements_of(b))
+        assert not (na & nb), (
+            f"{case.name}: {a} and {b} share nodes {na & nb} "
+            f"(ref {case.ref})")
+    for a, b in case.expect_colocated:
+        na, nb = set(placements_of(a)), set(placements_of(b))
+        assert na & nb, (
+            f"{case.name}: {a} on {na} and {b} on {nb} share nothing "
+            f"(ref {case.ref})")
+    return res

@@ -287,3 +287,160 @@ class TestCrossGangAntiAffinity:
         racks = {int(n[1]) % 2 for n in by_pod.values()}
         assert len(by_pod) == 2, by_pod
         assert len(racks) == 2, by_pod
+
+
+class TestInCycleExclusion:
+    """The generalized in-cycle exclusion terms (round-3 VERDICT item 2):
+    asymmetric required anti-affinity, pending-vs-pending NodePorts, and
+    reverse anti-affinity — enforced by EVERY placement action through
+    the cycle's claimed-domain table, including victim placements.
+
+    Ref ``k8s_internal/predicates/predicates.go:70-140`` (InterPodAffinity
+    and NodePorts dispatched per candidate node against virtually-
+    allocated session state)."""
+
+    @staticmethod
+    def _nodes(n=4, accel=8.0):
+        return [apis.Node(name=f"n{i}",
+                          allocatable=apis.ResourceVec(accel, 64.0, 256.0),
+                          labels={"kubernetes.io/hostname": f"n{i}"})
+                for i in range(n)]
+
+    @staticmethod
+    def _queues(quota=32.0):
+        return [apis.Queue(name="dept", accel=apis.QueueResource(quota=quota)),
+                apis.Queue(name="q", parent="dept",
+                           accel=apis.QueueResource(quota=quota))]
+
+    def test_asymmetric_anti_same_cycle(self):
+        """Gang `victim-labels` carries app=db labels and NO terms; gang
+        `avoider` carries a required anti term vs app=db.  Arriving in
+        ONE cycle they must not co-land on a node, whichever places
+        first (forward + reverse term rows)."""
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),),
+                                    anti=True, required=True)
+        groups = [apis.PodGroup(name="labels", queue="q", min_member=2),
+                  apis.PodGroup(name="avoider", queue="q", min_member=2)]
+        pods = (
+            [apis.Pod(name=f"labels-{i}", group="labels",
+                      resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                      labels={"app": "db"}) for i in range(2)]
+            + [apis.Pod(name=f"avoider-{i}", group="avoider",
+                        resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                        pod_affinity=[term]) for i in range(2)])
+        cluster = Cluster.from_objects(self._nodes(), self._queues(),
+                                       groups, pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        label_nodes = {v for k, v in by_pod.items() if k.startswith("labels")}
+        avoid_nodes = {v for k, v in by_pod.items() if k.startswith("avoider")}
+        assert len(by_pod) == 4, by_pod
+        assert not (label_nodes & avoid_nodes), by_pod
+
+    def test_pending_nodeports_never_collide(self):
+        """Two pending gangs requesting the same host port cannot share a
+        node in one cycle (upstream NodePorts over assumed pods); a
+        third gang without ports packs freely."""
+        groups = [apis.PodGroup(name=g, queue="q", min_member=1)
+                  for g in ("pa", "pb", "plain")]
+        pods = [
+            apis.Pod(name="pa-0", group="pa",
+                     resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                     host_ports=[8080]),
+            apis.Pod(name="pb-0", group="pb",
+                     resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                     host_ports=[8080]),
+            apis.Pod(name="plain-0", group="plain",
+                     resources=apis.ResourceVec(1.0, 1.0, 1.0)),
+        ]
+        cluster = Cluster.from_objects(self._nodes(), self._queues(),
+                                       groups, pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 3, by_pod
+        assert by_pod["pa-0"] != by_pod["pb-0"], by_pod
+
+    def test_port_replicas_spread_within_gang(self):
+        """Replicas of ONE gang sharing a host port spread one-per-node
+        (the NodePorts filter forbids two on a node)."""
+        groups = [apis.PodGroup(name="svc", queue="q", min_member=3)]
+        pods = [apis.Pod(name=f"svc-{i}", group="svc",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         host_ports=[9090]) for i in range(3)]
+        cluster = Cluster.from_objects(self._nodes(), self._queues(),
+                                       groups, pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 3, by_pod
+        assert len(set(by_pod.values())) == 3, by_pod
+
+    def test_reverse_anti_vs_running(self):
+        """A RUNNING pod's own required anti term excludes a matching
+        incoming pod from its node — the reverse InterPodAffinity
+        direction, via the snapshot filter masks."""
+        term = apis.PodAffinityTerm(match_labels=(("app", "web"),),
+                                    anti=True, required=True)
+        groups = [apis.PodGroup(name="guard", queue="q", min_member=1,
+                                last_start_timestamp=0.0),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [
+            apis.Pod(name="guard-0", group="guard",
+                     resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                     status=apis.PodStatus.RUNNING, node="n0",
+                     pod_affinity=[term]),
+            apis.Pod(name="web-0", group="web",
+                     resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                     labels={"app": "web"}),
+        ]
+        cluster = Cluster.from_objects(self._nodes(), self._queues(),
+                                       groups, pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert by_pod.get("web-0") not in (None, "n0"), by_pod
+
+    def test_reclaim_placement_respects_anti_terms(self):
+        """A preemptor placed by RECLAIM claims its domains: a
+        conflicting gang placed later in the same cycle (by allocate
+        next action or the same wavefront) cannot co-land — the victim
+        actions honour and update the claimed-domain table."""
+        # 2 nodes x 2 accel, fully occupied by over-quota queue qv;
+        # under-served queue q reclaims for two 1-pod gangs that carry
+        # mutual anti terms (must land on distinct nodes even though
+        # both are placed by reclaim in one cycle).
+        nodes = self._nodes(n=2, accel=2.0)
+        queues = [
+            apis.Queue(name="dept", accel=apis.QueueResource(quota=4.0)),
+            apis.Queue(name="q", parent="dept",
+                       accel=apis.QueueResource(quota=2.0)),
+            apis.Queue(name="qv", parent="dept",
+                       accel=apis.QueueResource(quota=1.0)),
+        ]
+        term = apis.PodAffinityTerm(match_labels=(("app", "ha"),),
+                                    anti=True, required=True)
+        groups, pods = [], []
+        for i in range(4):  # 4 running pods fill both nodes
+            groups.append(apis.PodGroup(
+                name=f"run-{i}", queue="qv", min_member=1,
+                last_start_timestamp=0.0))
+            pods.append(apis.Pod(
+                name=f"run-{i}-0", group=f"run-{i}",
+                resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                status=apis.PodStatus.RUNNING, node=f"n{i % 2}"))
+        for gname in ("ha-a", "ha-b"):
+            groups.append(apis.PodGroup(name=gname, queue="q",
+                                        min_member=1))
+            pods.append(apis.Pod(
+                name=f"{gname}-0", group=gname,
+                resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                labels={"app": "ha"}, pod_affinity=[term]))
+        cluster = Cluster.from_objects(nodes, queues, groups, pods, None)
+        res = Scheduler().run_once(cluster)
+        placed = np.asarray(res.tensors.placements)
+        alloc = np.asarray(res.tensors.allocated)
+        # both ha gangs placed (pipelined onto victim capacity), on
+        # DISTINCT nodes
+        ha_rows = [gi for gi in range(placed.shape[0])
+                   if alloc[gi] and (placed[gi] >= 0).any()]
+        ha_nodes = [placed[gi][placed[gi] >= 0][0] for gi in ha_rows]
+        assert len(res.evictions) >= 2, res.evictions
+        assert len(ha_nodes) == 2 and ha_nodes[0] != ha_nodes[1], ha_nodes
