@@ -277,11 +277,11 @@ class AllocateConfig:
     #: ``QueueDepthPerAction`` ("max number of jobs to try for action per
     #: queue", ``conf/scheduler_conf.go:56``); None = unlimited.
     queue_depth: int | None = None
-    #: re-sort the queue heap every wavefront chunk (the tensorized
-    #: equivalent of the reference's dynamic two-level heap, which
-    #: re-sorts after every single allocation) vs freeze the order at
-    #: cycle start.  With ``batch_size=1`` dynamic ordering is *exactly*
-    #: the reference's per-pop re-sort semantics.
+    #: order gangs by the PREDICTED pop sequence of the reference's
+    #: dynamic two-level heap (hoisted — see allocate()), with a live
+    #: per-chunk over-fair-share gate, vs freeze the job order at cycle
+    #: start.  Exact while pops succeed; placement failures and elastic
+    #: re-pushes perturb the tail of the order within an action.
     dynamic_order: bool = True
     #: gangs attempted in parallel per wavefront chunk.  Each chunk
     #: orders the remaining gangs by live fairness keys, attempts the
@@ -1109,14 +1109,18 @@ def allocate(
         static_rank = jnp.zeros((G,), jnp.int32).at[order0].set(
             jnp.arange(G, dtype=jnp.int32))
     else:
-        # Dynamic ordering decomposes the two-level heap: only the
-        # QUEUE-level keys are live (allocation moves them); the job
-        # keys (below-min, priority, creation) are snapshot-static.  The
-        # per-chunk [G] 8-key lexsort is therefore replaced by a hoisted
-        # static job rank + a per-chunk sort-free [Q,Q] dense queue-
-        # class rank + ONE single-key argsort — the same total order,
-        # fewer in-loop sort kernels (sorts in while_loop bodies carry a
-        # large fixed cost on this platform).
+        # Dynamic ordering PREDICTS the reference heap's whole pop
+        # sequence, hoisted: when pops succeed, queue allocation after a
+        # queue's first j pops is exactly qa_start plus those pops'
+        # cumulative request — so every gang's AT-POP queue key
+        # (over_fs, over_quota, -priority, dominant share) is a static
+        # function of the snapshot, and ONE hoisted lexsort reproduces
+        # the interleaved pop order the heap's per-pop re-sort would
+        # produce.  Chunks then just take the first B remaining gangs of
+        # this order (a cumsum compaction — no in-loop sort at all).
+        # Divergence from the prediction — placement failures, accept
+        # conflicts, elastic re-pushes — is bounded per action (see the
+        # fairness-gate note in the chunk) and corrected next cycle.
         below_min = g.running_count < g.min_member
         sjr_perm = jnp.lexsort((
             g.creation_order.astype(jnp.float32),
@@ -1124,7 +1128,40 @@ def allocate(
             (~below_min).astype(jnp.float32)))
         static_job_rank = jnp.zeros((G,), jnp.int32).at[sjr_perm].set(
             jnp.arange(G, dtype=jnp.int32))                   # [G]
-    gq_idx = jnp.maximum(g.queue, 0)
+        gq0 = jnp.maximum(g.queue, 0)
+        # only gangs this action can actually pop contribute to the
+        # prediction — backed-off/prefiltered gangs never pop, and
+        # already-allocated gangs' requests are in qa0 already
+        gang_req_all = jnp.sum(jnp.where(
+            (g.task_valid & remaining0[:, None])[:, :, None],
+            g.task_req, 0.0), axis=1)                           # [G, R]
+        # exclusive per-queue cumulative request along the static job
+        # order, O(G·R): queue-major sort, one cumsum, subtract each
+        # queue's segment-start prefix (a [G, Q, R] one-hot cumsum
+        # would be ~GB-scale at 50k gangs × many queues)
+        ord2 = jnp.lexsort((static_job_rank.astype(jnp.float32),
+                            gq0.astype(jnp.float32)))
+        req2 = gang_req_all[ord2]
+        cs_excl = jnp.cumsum(req2, axis=0) - req2               # [G, R]
+        qm = gq0[ord2]
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), bool), qm[1:] != qm[:-1]])
+        base = jnp.zeros((q.q + 1,) + req2.shape[1:], req2.dtype).at[
+            jnp.where(is_first, qm, q.q)].set(cs_excl)[:q.q]    # [Q, R]
+        cum_excl_g = jnp.zeros_like(gang_req_all).at[ord2].set(
+            cs_excl - base[qm])                                 # [G, R]
+        qa0 = init.queue_allocated
+        at_pop = qa0[gq0] + cum_excl_g                          # [G, R]
+        pop_fs = jnp.any(at_pop > fair_share[gq0] + EPS, -1)
+        pop_qt = jnp.any(at_pop > quota_eff[gq0] + EPS, -1)
+        pop_dom = jnp.max(at_pop / jnp.maximum(total, EPS)[None, :], -1)
+        nprio_q = -q.priority.astype(jnp.float32)
+        pop_order = jnp.lexsort((
+            static_job_rank.astype(jnp.float32),
+            pop_dom,
+            nprio_q[gq0],
+            pop_qt.astype(jnp.float32),
+            pop_fs.astype(jnp.float32)))                        # [G]
 
     chain = _chain_membership(q.parent, num_levels)
 
@@ -1264,42 +1301,32 @@ def allocate(
         free, dev, qa, qan = (res.free, res.device_free, res.queue_allocated,
                               res.queue_allocated_nonpreemptible)
         if config.dynamic_order:
-            # fairness gate: while ANY under-fair-share queue still has
-            # remaining gangs, over-fair-share queues sit the chunk out.
-            # The reference's heap gives them the same treatment — an
-            # under-fs queue sorts strictly first and its (re-pushed)
-            # jobs drain before an over-fs queue is popped at all, so
-            # contested capacity goes to under-fs queues first.
-            over_fs, over_quota, neg_prio, dom_share = \
-                ordering.queue_order_keys(q, qa, fair_share, total)
-            elig = remaining & (over_fs[g.queue] < 0.5)
+            # first B remaining gangs of the hoisted pop order (cumsum
+            # compaction — no in-loop sort), with the LIVE over-fs gate:
+            # while ANY under-fair-share queue still has remaining
+            # gangs, over-fs queues (incl. re-pushed elastic gangs whose
+            # quorum already drove their queue over) sit the chunk out —
+            # the reference heap's tier-1 treatment
+            over_fs_live = jnp.any(
+                qa > fair_share + EPS, axis=-1)                   # [Q]
+            elig = remaining & ~over_fs_live[jnp.maximum(g.queue, 0)]
             elig = jnp.where(jnp.any(elig), elig, remaining)
-            # dense lexicographic rank of each queue's live key tuple,
-            # via [Q, Q] pairwise strict-less (sort-free — Q is small):
-            # EQUAL-key queues share a rank, so their gangs interleave
-            # by the static job keys exactly as the full lexsort would
-            def _lt(a, b):
-                return a[:, None] < b[None, :]
-
-            def _eq(a, b):
-                return a[:, None] == b[None, :]
-
-            less = (_lt(over_fs, over_fs)
-                    | (_eq(over_fs, over_fs)
-                       & (_lt(over_quota, over_quota)
-                          | (_eq(over_quota, over_quota)
-                             & (_lt(neg_prio, neg_prio)
-                                | (_eq(neg_prio, neg_prio)
-                                   & _lt(dom_share, dom_share)))))))
-            qrank = jnp.sum(less.astype(jnp.int32), axis=0)       # [Q]
-            composite = (static_job_rank + qrank[gq_idx] * G
-                         + jnp.where(elig, 0, 2 * q.q * G))
+            flags = elig[pop_order]                               # [G]
+            rnk = jnp.cumsum(flags.astype(jnp.int32)) - 1
+            pos = jnp.where(flags & (rnk < B), rnk, B)
+            cand = jnp.full((B + 1,), G, jnp.int32).at[pos].set(
+                pop_order)[:B]
+            cand_valid = jnp.zeros((B + 1,), bool).at[pos].set(
+                True)[:B]
+            # junk slots KEEP the out-of-range index G: their commit
+            # scatters drop (out-of-bounds) instead of racing a real
+            # gang's row; gathers at G clamp to harmless reads that
+            # cand_valid discards
         else:
             # frozen keys, retired gangs pushed last
-            elig = remaining
             composite = static_rank + jnp.where(remaining, 0, 2 * G)
-        cand = jnp.argsort(composite)[:B]                         # [B]
-        cand_valid = elig[cand]
+            cand = jnp.argsort(composite)[:B]                     # [B]
+            cand_valid = remaining[cand]
         if config.queue_depth is not None:
             # per-queue attempt budget (ref QueueDepthPerAction): a
             # candidate is eligible while its queue's prior attempts plus
@@ -1322,6 +1349,15 @@ def allocate(
         placed_cnt = jnp.sum((prior_b >= 0).astype(jnp.int32), -1)
         need = g.min_needed[cand]
         quota_b = jnp.where(placed_cnt < need, need - placed_cnt, 1)
+
+        # NOTE on mid-action fairness drift: the hoisted pop order is
+        # exact while pops succeed; placement failures and accept
+        # conflicts can let a queue fall behind its predicted
+        # allocation, after which the frozen order may favour it
+        # slightly ahead of the live heap for the rest of the action —
+        # bounded by the failed requests, corrected next cycle.  (A live
+        # per-chunk heap-key lookahead was tried and reverted: its
+        # per-chunk op cost exceeded the entire sort it replaced.)
 
         # independent attempts against chunk-start state (the vmapped
         # replacement for the reference's one-job-at-a-time hot loop);
