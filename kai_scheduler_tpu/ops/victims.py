@@ -505,13 +505,14 @@ def solve_for_preemptor(
             success &= _ancestor_gate(q.parent, queue, num_levels,
                                       qa_eff, fair_share, total_req)
         if consolidate:
-            free3, dev3, moves, all_ok = _replace_victims(
+            free3, dev3, ext3, moves, all_ok = _replace_victims(
                 state, mask_k, free2, dev2, n.releasing + extra_eff,
                 state.nodes.device_releasing + extra_dev_eff,
+                ext2, state.nodes.extended_releasing + ext_extra_eff,
                 max_pods=max(512, config.max_consolidation_preemptees * T))
             return success & all_ok, (
                 free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
-                extra_eff, extra_dev_eff, ext2, ext_extra_eff, k)
+                extra_eff, extra_dev_eff, ext3, ext_extra_eff, k)
         return success, (
             free2, dev2, qa2, qan2, nodes_t, dev_t, pipe_t, no_moves,
             extra_eff, extra_dev_eff, ext2, ext_extra_eff, k)
@@ -604,14 +605,17 @@ def solve_for_preemptor(
 
 def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
                      device_free: jax.Array, releasing: jax.Array,
-                     device_releasing: jax.Array, max_pods: int = 512):
+                     device_releasing: jax.Array,
+                     ext_free: jax.Array, ext_releasing: jax.Array,
+                     max_pods: int = 512):
     """Greedy re-placement of evicted consolidation victims — the
     ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
     scenario is valid only if *every* victim fits somewhere on the
-    post-preemptor state.  Feasibility = resources + the pod's node-filter
-    class (taints/affinity); binpack by least free accel.  Moves may
-    draw on releasing capacity (including other victims' freed spots) —
-    they are always pipelined rebinds, waiting for the old pods to vacate.
+    post-preemptor state.  Feasibility = resources + extended (MIG)
+    scalars + the pod's node-filter class (taints/affinity); binpack by
+    least free accel.  Moves may draw on releasing capacity (including
+    other victims' freed spots) — they are always pipelined rebinds,
+    waiting for the old pods to vacate.
 
     The loop runs over the (bounded) victim set, not the whole pod axis —
     an M-length device loop at 50k running pods faults the TPU.  A
@@ -619,8 +623,8 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
     (``all_ok=False``), mirroring MaxNumberConsolidationPreemptees-style
     caps.
 
-    Returns (free' [N, R], device_free' [N, D], moves [M] i32 node per
-    victim, all_ok [])."""
+    Returns (free' [N, R], device_free' [N, D], extended_free' [N, E],
+    moves [M] i32 node per victim, all_ok [])."""
     r, n = state.running, state.nodes
     M = r.m
     D = n.d
@@ -630,7 +634,7 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
     kvalid = jnp.arange(K) < n_vic
 
     def body(kk, carry):
-        free_l, dev_l, moves, all_ok = carry
+        free_l, dev_l, ext_l, moves, all_ok = carry
         m = idxs[kk]
         needed = kvalid[kk] & mask[m]
         req = r.req[m]
@@ -646,6 +650,10 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
         dev_avail = dev_l + device_releasing
         fit = (jnp.all(avail + EPS >= req[None, :], axis=-1) & n.valid
                & n.filter_masks[r.filter_class[m]])
+        # extended (MIG) scalars the victim holds must fit the target too
+        ext_req = r.extended[m]                                # [E]
+        fit &= jnp.all(ext_l + ext_releasing + EPS >= ext_req[None, :],
+                       axis=-1)
         frac_fit = jnp.max(dev_avail, axis=-1) >= p_n - EPS
         whole_free = jnp.sum((dev_avail >= 1.0 - EPS).astype(free_l.dtype),
                              axis=-1)
@@ -659,6 +667,7 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
         delta = delta.at[0].set(
             jnp.where(placed, jnp.where(is_frac, p, req[0]), 0.0))
         free_l = free_l.at[node].add(-delta)
+        ext_l = ext_l.at[node].add(-jnp.where(placed, ext_req, 0.0))
         # device debit: fraction joins its best-fitting device; whole
         # takes the first fully-free devices
         dev_row = dev_avail[node]
@@ -670,14 +679,21 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
             is_frac, p * (jnp.arange(D) == frac_dev),
             take.astype(dev_row.dtype))
         dev_l = dev_l.at[node].add(-jnp.where(placed, dev_delta, 0.0))
-        moves = moves.at[m].set(jnp.where(placed, node, -1))
+        # junk iterations (kk >= n_vic gather the fill index 0) must NOT
+        # touch pod 0's recorded move — an unconditional set clobbered a
+        # real victim's rebind target back to -1, shipping its eviction
+        # without the pipelined re-placement (caught by the scenario
+        # catalog's MIG consolidation case)
+        moves = moves.at[m].set(
+            jnp.where(needed, jnp.where(placed, node, -1), moves[m]))
         all_ok = all_ok & (~needed | placed)
-        return free_l, dev_l, moves, all_ok
+        return free_l, dev_l, ext_l, moves, all_ok
 
-    return lax.fori_loop(
+    free2, dev2, ext2, moves, all_ok = lax.fori_loop(
         0, K, body,
-        (free, device_free, jnp.full((M,), -1, jnp.int32),
-         n_vic <= K))
+        (free, device_free, ext_free,
+         jnp.full((M,), -1, jnp.int32), n_vic <= K))
+    return free2, dev2, ext2, moves, all_ok
 
 
 def _freed_by_lane(state: ClusterState, lane: jax.Array, B: int,

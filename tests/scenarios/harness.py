@@ -105,6 +105,12 @@ class Case:
     expect_colocated: list = dataclasses.field(default_factory=list)
 
 
+#: cluster clock for scenario runs — running gangs' start stamps are
+#: _NOW - runtime_s (negative stamps would collide with the nil
+#: "never started" sentinel)
+_NOW = 1e6
+
+
 def _build(case: Case):
     nodes = []
     for ns in case.nodes:
@@ -145,7 +151,8 @@ def _build(case: Case):
             preemptibility=(apis.Preemptibility.PREEMPTIBLE
                             if gs.preemptible
                             else apis.Preemptibility.NON_PREEMPTIBLE),
-            last_start_timestamp=-gs.runtime_s if running else None,
+            last_start_timestamp=(_NOW - gs.runtime_s) if running
+            else None,
             sub_groups=sub_groups,
             topology_constraint=topo))
         for t in range(gs.tasks):
@@ -165,12 +172,14 @@ def _build(case: Case):
                 if gs.devices:
                     pod.accel_devices = [gs.devices[t % len(gs.devices)]]
             pods.append(pod)
-    return Cluster.from_objects(nodes, queues, groups, pods,
+    cluster = Cluster.from_objects(nodes, queues, groups, pods,
                                 (apis.Topology(
                                     name="default",
                                     levels=(case.topology_levels
                                             + ["kubernetes.io/hostname"]))
                                  if case.topology_levels else None))
+    cluster.now = _NOW
+    return cluster
 
 
 def run_case(case: Case):

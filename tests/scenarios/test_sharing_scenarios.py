@@ -1,0 +1,148 @@
+"""GPU-sharing scenario catalog — fractional portions, memory-based
+shares, and MIG extended resources, traceable to the reference suites
+``allocateFractionalGpu_test.go``, ``allocateGpuMemory_test.go`` and
+``allocateMIG_test.go`` (case names quoted in each ``ref``).
+"""
+import pytest
+
+from .harness import Case, G, N, Q, run_case
+
+MIG_1G = "nvidia.com/mig-1g.5gb"
+
+CASES = [
+    # ---- fractional portions (allocateFractionalGpu_test.go) -----------
+    Case(
+        name="two_halves_share_one_device",
+        ref='allocateFractionalGpu_test.go: "Allocate 2 pods to use '
+            'shared GPU"',
+        nodes=[N("n0", gpu=1)],
+        gangs=[G("f0", tasks=1, gpu=0, portion=0.5),
+               G("f1", tasks=1, gpu=0, portion=0.5)],
+        expect={"f0": True, "f1": True},
+        expect_nodes={"f0": {"n0"}, "f1": {"n0"}},
+    ),
+    Case(
+        name="fraction_and_whole_coexist",
+        ref='allocateFractionalGpu_test.go: "Fraction job and whole-GPU '
+            'job on one node"',
+        nodes=[N("n0", gpu=2)],
+        gangs=[G("frac", tasks=1, gpu=0, portion=0.5),
+               G("whole", tasks=1, gpu=1)],
+        expect={"frac": True, "whole": True},
+    ),
+    Case(
+        name="oversized_fraction_fails",
+        ref='allocateFractionalGpu_test.go: "Fill GPU up - fail '
+            'allocating 0.6 GPU twice"',
+        nodes=[N("n0", gpu=1)],
+        gangs=[G("f0", tasks=1, gpu=0, portion=0.6),
+               G("f1", tasks=1, gpu=0, portion=0.6)],
+        expect={"f0": True, "f1": 0},
+    ),
+    Case(
+        name="three_fractions_two_devices",
+        ref='allocateFractionalGpu_test.go: "Allocate 3 fractions over '
+            '2 GPUs"',
+        nodes=[N("n0", gpu=2)],
+        gangs=[G("f0", tasks=1, gpu=0, portion=0.5),
+               G("f1", tasks=1, gpu=0, portion=0.5),
+               G("f2", tasks=1, gpu=0, portion=0.5)],
+        expect={"f0": True, "f1": True, "f2": True},
+    ),
+    Case(
+        name="fraction_joins_running_sharer",
+        ref='allocateFractionalGpu_test.go: "Add a fraction to a used '
+            'shared GPU"',
+        nodes=[N("n0", gpu=1), N("n1", gpu=1)],
+        gangs=[G("run", tasks=1, gpu=0, portion=0.5, on=["n0"],
+                 devices=[0]),
+               G("new", tasks=1, gpu=0, portion=0.5)],
+        # gpusharingorder: the new fraction prefers the node whose
+        # device already holds a sharer
+        expect={"new": True},
+        expect_nodes={"new": {"n0"}},
+    ),
+    Case(
+        name="whole_gpu_needs_fully_free_device",
+        ref='allocateFractionalGpu_test.go: "Whole GPU job blocked by '
+            'fraction"',
+        nodes=[N("n0", gpu=1)],
+        gangs=[G("run", tasks=1, gpu=0, portion=0.5, on=["n0"],
+                 devices=[0]),
+               G("whole", tasks=1, gpu=1)],
+        expect={"whole": 0},
+    ),
+    # ---- memory-based shares (allocateGpuMemory_test.go) ---------------
+    Case(
+        name="memory_request_shares_device",
+        ref='allocateGpuMemory_test.go: "Pending job requests gpu '
+            'memory"',
+        nodes=[N("n0", gpu=1, gpu_mem_gib=16.0)],
+        gangs=[G("m0", tasks=1, gpu=0, gpu_mem=8.0),
+               G("m1", tasks=1, gpu=0, gpu_mem=8.0)],
+        expect={"m0": True, "m1": True},
+        expect_nodes={"m0": {"n0"}, "m1": {"n0"}},
+    ),
+    Case(
+        name="memory_over_device_capacity_fails",
+        ref='allocateGpuMemory_test.go: "Pending job requests GPU '
+            'memory, memory resource cannot be allocated"',
+        nodes=[N("n0", gpu=1, gpu_mem_gib=16.0)],
+        gangs=[G("m0", tasks=1, gpu=0, gpu_mem=12.0),
+               G("m1", tasks=1, gpu=0, gpu_mem=12.0)],
+        expect={"m0": True, "m1": 0},
+    ),
+    Case(
+        name="memory_is_node_relative",
+        ref='allocateGpuMemory_test.go: "GPU memory across node device '
+            'sizes"',
+        # 12 GiB share: fits the 16-GiB device, NOT the 8-GiB one
+        nodes=[N("small", gpu=1, gpu_mem_gib=8.0),
+               N("big", gpu=1, gpu_mem_gib=16.0)],
+        gangs=[G("m0", tasks=1, gpu=0, gpu_mem=12.0)],
+        expect={"m0": True},
+        expect_nodes={"m0": {"big"}},
+    ),
+    # ---- MIG extended resources (allocateMIG_test.go) ------------------
+    Case(
+        name="mig_profile_capacity",
+        ref='allocateMIG_test.go: "MIG job requesting MIG device"',
+        nodes=[N("n0", gpu=8, mig={MIG_1G: 2})],
+        gangs=[G("mig0", tasks=1, gpu=0, mig={MIG_1G: 1}),
+               G("mig1", tasks=1, gpu=0, mig={MIG_1G: 1}),
+               G("mig2", tasks=1, gpu=0, mig={MIG_1G: 1})],
+        expect={"mig0": True, "mig1": True, "mig2": 0},
+    ),
+    Case(
+        name="mig_node_selection",
+        ref='allocateMIG_test.go: "Pending MIG job with node without '
+            'MIG resources"',
+        nodes=[N("plain", gpu=8), N("migged", gpu=8, mig={MIG_1G: 1})],
+        gangs=[G("mig0", tasks=1, gpu=0, mig={MIG_1G: 1})],
+        expect={"mig0": True},
+        expect_nodes={"mig0": {"migged"}},
+    ),
+    Case(
+        name="running_mig_slices_held",
+        ref='allocateMIG_test.go: "MIG job requesting MIG device on '
+            'node with running MIG jobs"',
+        nodes=[N("n0", gpu=8, mig={MIG_1G: 2})],
+        gangs=[G("run", tasks=2, gpu=0, mig={MIG_1G: 1}, on=["n0"]),
+               G("mig0", tasks=1, gpu=0, mig={MIG_1G: 1})],
+        expect={"mig0": 0},
+    ),
+    Case(
+        name="mixed_mig_and_whole_gpu",
+        ref='allocateMIG_test.go: "MIG job with multiple tasks '
+            'requesting MIG device"',
+        nodes=[N("n0", gpu=2, mig={MIG_1G: 4})],
+        gangs=[G("mig", tasks=3, gpu=0, mig={MIG_1G: 1}),
+               G("whole", tasks=2, gpu=1)],
+        expect={"mig": True, "whole": True},
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
+def test_sharing_scenarios(case):
+    run_case(case)

@@ -1,0 +1,127 @@
+"""Topology + subgroup scenario catalog, traceable to the reference
+suites ``allocateTopology_test.go`` and ``allocate_subgroups_test.go``
+(case names quoted in each ``ref``).
+
+Topology tree used throughout: 2 racks × 2 nodes (level label "rack").
+"""
+import pytest
+
+from .harness import Case, G, N, Q, run_case
+
+
+def _racked(gpu=4.0, racks=2, per=2):
+    return [N(f"n{r}{i}", gpu=gpu, labels={"rack": f"r{r}"})
+            for r in range(racks) for i in range(per)]
+
+
+RACK0 = {"n00", "n01"}
+RACK1 = {"n10", "n11"}
+
+CASES = [
+    Case(
+        name="required_rack_confines_gang",
+        ref='allocateTopology_test.go: "Required Topology - allocate '
+            'whole PodGroup on a single Rack"',
+        nodes=_racked(),
+        topology_levels=["rack"],
+        gangs=[G("job", tasks=8, gpu=1, topology=("rack", None))],
+        expect={"job": True},
+        expect_nodes={"job": RACK0 | RACK1},  # checked tighter below
+    ),
+    Case(
+        name="required_rack_too_big_fails",
+        ref='allocateTopology_test.go: "Required Topology - PodGroup '
+            'larger than any domain stays pending"',
+        nodes=_racked(),
+        topology_levels=["rack"],
+        gangs=[G("big", tasks=12, gpu=1, topology=("rack", None))],
+        expect={"big": 0},
+    ),
+    Case(
+        name="binpack_picks_fullest_domain",
+        ref='allocateTopology_test.go: "Bin Packing - allocate on '
+            'domain with least free resources (most occupied)"',
+        nodes=_racked(),
+        topology_levels=["rack"],
+        gangs=[G("occupant", tasks=2, gpu=1, on=["n10", "n11"]),
+               G("job", tasks=4, gpu=1, topology=("rack", None))],
+        # rack1 (6 free) is fuller than rack0 (8 free): binpack there
+        expect={"job": True},
+        expect_nodes={"job": RACK1},
+    ),
+    Case(
+        name="preferred_rack_keeps_gang_local",
+        ref='allocateTopology_test.go: "Preferred Topology - allocate '
+            'on closest domain"',
+        nodes=_racked(),
+        topology_levels=["rack"],
+        gangs=[G("job", tasks=4, gpu=1, topology=(None, "rack"))],
+        expect={"job": True},
+    ),
+    Case(
+        name="two_required_gangs_two_racks",
+        ref='allocateTopology_test.go: "Multiple PodGroups with '
+            'Required Topology on distinct domains"',
+        nodes=_racked(),
+        topology_levels=["rack"],
+        gangs=[G("a", tasks=6, gpu=1, topology=("rack", None)),
+               G("b", tasks=6, gpu=1, topology=("rack", None))],
+        expect={"a": True, "b": True},
+        expect_disjoint=[("a", "b")],
+    ),
+    # ---- subgroups (allocate_subgroups_test.go) ------------------------
+    Case(
+        name="subgroups_quorum_both_sides",
+        ref='allocate_subgroups_test.go: "Allocate job with SubGroups"',
+        nodes=[N("n0", gpu=4)],
+        gangs=[G("ps-wk", tasks=4, gpu=1, min_member=4,
+                 subgroups=[("ps", 2), ("wk", 2)],
+                 subgroup_of=["ps", "ps", "wk", "wk"])],
+        expect={"ps-wk": True},
+    ),
+    Case(
+        name="subgroup_quorum_unsatisfiable_fails_whole_gang",
+        ref='allocate_subgroups_test.go: "Allocate job with SubGroups - '
+            'cannot satisfy sub group gang"',
+        nodes=[N("n0", gpu=3)],
+        gangs=[G("ps-wk", tasks=4, gpu=1, min_member=4,
+                 subgroups=[("ps", 2), ("wk", 2)],
+                 subgroup_of=["ps", "ps", "wk", "wk"])],
+        expect={"ps-wk": 0},
+    ),
+    Case(
+        name="multiple_subgroup_jobs",
+        ref='allocate_subgroups_test.go: "Allocate multiple jobs with '
+            'SubGroups"',
+        nodes=[N("n0", gpu=4), N("n1", gpu=4)],
+        gangs=[G("j0", tasks=4, gpu=1, min_member=4,
+                 subgroups=[("a", 2), ("b", 2)],
+                 subgroup_of=["a", "a", "b", "b"]),
+               G("j1", tasks=4, gpu=1, min_member=4,
+                 subgroups=[("a", 2), ("b", 2)],
+                 subgroup_of=["a", "a", "b", "b"])],
+        expect={"j0": True, "j1": True},
+    ),
+    Case(
+        name="unbalanced_subgroup_hierarchy",
+        ref='allocate_subgroups_test.go: "Allocate job with SubGroups - '
+            'unbalanced hierarchy structure"',
+        nodes=[N("n0", gpu=6)],
+        gangs=[G("uneven", tasks=6, gpu=1, min_member=6,
+                 subgroups=[("ps", 1), ("wk", 5)],
+                 subgroup_of=["ps", "wk", "wk", "wk", "wk", "wk"])],
+        expect={"uneven": True},
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
+def test_topology_scenarios(case):
+    res = run_case(case)
+    if case.name == "required_rack_confines_gang":
+        # all placements inside ONE rack
+        import numpy as np
+        pl = np.asarray(res.tensors.placements)
+        nodes = [n.name for n in case.nodes]
+        used = {nodes[v][1] for v in pl[0][pl[0] >= 0]}
+        assert len(used) == 1, used
