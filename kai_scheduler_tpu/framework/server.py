@@ -219,21 +219,62 @@ class SchedulerServer:
                 else:
                     self.send_error(404)
 
+            def _send_pb(self, msg, code=200):
+                body = msg.SerializeToString()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/x-protobuf")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):  # noqa: N802
                 length = int(self.headers.get("Content-Length", 0))
+                # the sidecar protocol speaks two framings over the same
+                # endpoints: the stable JSON documents, and the typed
+                # protobuf schema (wire/sidecar.proto — SURVEY §7d's
+                # proto boundary; HTTP Content-Length is the length
+                # prefix).  Content-Type selects.
+                proto = self.headers.get(
+                    "Content-Type", "").startswith("application/x-protobuf")
                 try:
+                    body = self.rfile.read(length)
+                    if proto:
+                        from ..wire import codec, sidecar_pb2 as pb
+                        if self.path == "/cycle":
+                            doc = pb.ClusterDoc()
+                            doc.ParseFromString(body)
+                            result = outer.scheduler.run_once(
+                                codec.cluster_from_msg(doc))
+                            self._send_pb(codec.commit_to_msg(result))
+                        elif self.path == "/cluster":
+                            doc = pb.ClusterDoc()
+                            doc.ParseFromString(body)
+                            outer.cluster = codec.cluster_from_msg(doc)
+                            self._send_pb(pb.CommitSet())
+                        elif self.path == "/cluster/delta":
+                            delta = pb.ClusterDelta()
+                            delta.ParseFromString(body)
+                            codec.apply_delta_msg(outer.cluster, delta)
+                            self._send_pb(pb.CommitSet())
+                        elif self.path == "/cycle/stored":
+                            result = outer.scheduler.run_once(
+                                outer.cluster)
+                            self._send_pb(codec.commit_to_msg(result))
+                        else:
+                            self.send_error(404)
+                        return
                     if self.path == "/cycle":
-                        doc = json.loads(self.rfile.read(length).decode())
+                        doc = json.loads(body.decode())
                         self._send(run_cycle_doc(doc, outer.scheduler))
                     elif self.path == "/cluster":
                         # replace the stored cluster (upload once ...)
-                        doc = json.loads(self.rfile.read(length).decode())
+                        doc = json.loads(body.decode())
                         outer.cluster = load_cluster(doc)
                         self._send({"ok": True})
                     elif self.path == "/cluster/delta":
                         # ... then PATCH deltas instead of re-shipping
                         # the full document every cycle
-                        doc = json.loads(self.rfile.read(length).decode())
+                        doc = json.loads(body.decode())
                         apply_cluster_delta(outer.cluster, doc)
                         self._send({"ok": True})
                     elif self.path == "/cycle/stored":

@@ -128,7 +128,14 @@ class Session:
             nodes, queues, pod_groups, pods, topology, **snapshot_kwargs)
         if config.auto_tune:
             devices = index.needs_device_table
-            uniform = index.uniform_gangs and not devices
+            # the whole-gang kernel is exactly the sequential greedy
+            # under BINPACK scoring only (a filling node's score rises,
+            # so the greedy keeps hitting it — the capacity-count fill);
+            # under spread the per-task loop re-ranks after every task,
+            # so spread-configured shards keep the per-task kernel
+            uniform = (index.uniform_gangs and not devices
+                       and config.allocate.placement.binpack_accel
+                       and config.allocate.placement.binpack_cpu)
             sub_topo = (index.has_subgroup_topology
                         or index.has_required_topology)
             ext = index.has_extended_resources

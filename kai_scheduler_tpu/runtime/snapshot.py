@@ -168,10 +168,16 @@ def load_cluster(doc: dict) -> Cluster:
         sc = apis.StorageClass(**d)
         cluster.storage_classes[sc.name] = sc
     cluster.restarting = set(doc.get("restarting", []))
-    # rebuild the shared-device reservation registry from bound
-    # fractional pods — reservations are derived state (the reference
-    # reconciles reservation pods from the cluster the same way), so
-    # they are reconstructed rather than serialized
+    rebuild_reservations(cluster)
+    return cluster
+
+
+def rebuild_reservations(cluster: Cluster) -> None:
+    """Rebuild the shared-device reservation registry from bound
+    fractional pods — reservations are derived state (the reference
+    reconciles reservation pods from the cluster the same way), so
+    every wire ingest (JSON snapshot or proto ClusterDoc) reconstructs
+    them rather than serializing them."""
     for pod in cluster.pods.values():
         if (pod.node and pod.accel_devices
                 and (pod.accel_portion > 0 or pod.accel_memory_gib > 0)
@@ -180,7 +186,6 @@ def load_cluster(doc: dict) -> Cluster:
                                    apis.PodStatus.RELEASING)):
             cluster.reservations.acquire(pod.node, pod.accel_devices[0],
                                          pod.name)
-    return cluster
 
 
 def save(cluster: Cluster, path: str) -> None:

@@ -1,0 +1,223 @@
+"""Prometheus-backed usage source — the query-construction layer of
+``pkg/scheduler/cache/usagedb/prometheus/prometheus.go``.
+
+The reference builds PromQL strings per resource:
+
+- a decay factor ``0.5^((<anchor> - time()) / <half-life seconds>)``
+  (``getExponentialDecayQuery``, prometheus.go:290-300),
+- sliding windows as
+  ``sum_over_time(((<metric>) * (<decay>))[<window>:<resolution>])``
+  (prometheus.go:217),
+- tumbling windows as ``sum_over_time(<decayed metric>)`` ranged from
+  the latest cron reset to now (prometheus.go:230-260), the reset time
+  coming from a cron expression,
+
+normalizes allocation integrals by the capacity integral over the same
+window, and hands per-queue usage to the proportion plugin.  Staleness
+handling lives in the lister: a dead Prometheus degrades to plain
+weight-based fairness (usagedb.go:20-60).
+
+This module mirrors that construction against any Prometheus-compatible
+HTTP API.  The transport is a pluggable ``http_get(path, params) ->
+dict`` so tests drive it with a mock backend; the default uses stdlib
+urllib against ``address``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.parse
+import urllib.request
+from typing import Callable, Mapping
+
+import numpy as np
+
+from ..apis.types import NUM_RESOURCES, RESOURCE_ACCEL, RESOURCE_CPU
+from .usagedb import UsageParams
+
+#: ref prometheus.go queueNameLabel
+QUEUE_LABEL = "queue_name"
+
+#: resource slot -> default allocation / capacity metric names
+#: (ref prometheus.go allocationMetricsMap / capacityMetricsMap)
+DEFAULT_ALLOCATION_METRICS = {
+    RESOURCE_ACCEL: "kai_queue_allocated_gpus",
+    RESOURCE_CPU: "kai_queue_allocated_cpu_cores",
+}
+DEFAULT_CAPACITY_METRICS = {
+    RESOURCE_ACCEL: "kai_cluster_capacity_gpus",
+    RESOURCE_CPU: "kai_cluster_capacity_cpu_cores",
+}
+
+
+def decay_query(anchor_s: float, half_life_s: float | None) -> str:
+    """``getExponentialDecayQuery``: weight samples by how recent they
+    are, half-life ``half_life_s``; empty when decay is disabled."""
+    if half_life_s is None:
+        return ""
+    return f"0.5^(({int(anchor_s)} - time()) / {half_life_s:f})"
+
+
+def decayed_metric(metric: str, anchor_s: float,
+                   half_life_s: float | None) -> str:
+    d = decay_query(anchor_s, half_life_s)
+    return f"(({metric}) * ({d}))" if d else metric
+
+
+def sliding_window_query(metric: str, anchor_s: float,
+                         params: UsageParams,
+                         resolution_s: float = 60.0) -> str:
+    """``sum_over_time((<decayed>)[<window>:<resolution>])`` — the
+    sliding-window usage integral ending at the query instant."""
+    window = int(params.half_life_s * 4) if params.half_life_s else \
+        int(params.tumbling_window_s)
+    dm = decayed_metric(metric, anchor_s, params.half_life_s)
+    return f"sum_over_time(({dm})[{window}s:{int(resolution_s)}s])"
+
+
+def tumbling_window_query(metric: str, anchor_s: float,
+                          params: UsageParams) -> str:
+    """``sum_over_time(<decayed>)`` — evaluated as a range query from
+    the latest window reset (see :func:`latest_cron_reset`) to now."""
+    dm = decayed_metric(metric, anchor_s, params.half_life_s)
+    return f"sum_over_time({dm})"
+
+
+def latest_cron_reset(expr: str, now_s: float) -> float:
+    """Latest occurrence <= ``now_s`` of a 5-field cron expression
+    (minute hour day-of-month month day-of-week; ``*`` or integers) —
+    the tumbling window's reset anchor (ref cronWindowExpression).
+    Epoch seconds in UTC."""
+    import datetime as dt
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"cron expression needs 5 fields: {expr!r}")
+
+    def match(val: int, spec: str) -> bool:
+        return spec == "*" or int(spec) == val
+
+    t = dt.datetime.fromtimestamp(now_s, dt.timezone.utc).replace(
+        second=0, microsecond=0)
+    for _ in range(366 * 24 * 60 // max(1, 60)):  # scan back <= 1 year, hourly
+        day_ok = (match(t.day, fields[2]) and match(t.month, fields[3])
+                  and match(t.isoweekday() % 7, fields[4]))
+        if day_ok and match(t.hour, fields[1]):
+            # scan this hour's minutes downward
+            m = t
+            while m.hour == t.hour:
+                if match(m.minute, fields[0]) and m.timestamp() <= now_s:
+                    return m.timestamp()
+                if m.minute == 0:
+                    break
+                m -= dt.timedelta(minutes=1)
+        t = (t - dt.timedelta(hours=1)).replace(minute=59)
+    raise ValueError(f"no occurrence of {expr!r} within a year")
+
+
+def _default_http_get(address: str):
+    def get(path: str, query: dict) -> dict:
+        url = f"{address}{path}?{urllib.parse.urlencode(query)}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    return get
+
+
+@dataclasses.dataclass
+class PrometheusUsageClient:
+    """Constructs + issues the usage queries; returns per-queue usage
+    vectors normalized by the capacity integral — the quantity the
+    division kernel's ``k_value`` term consumes."""
+
+    address: str = "http://localhost:9090"
+    params: UsageParams = dataclasses.field(default_factory=UsageParams)
+    allocation_metrics: dict = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_ALLOCATION_METRICS))
+    capacity_metrics: dict = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_CAPACITY_METRICS))
+    #: cron reset for tumbling windows, e.g. "0 0 * * *" (midnight UTC)
+    cron_reset: str = "0 0 * * *"
+    resolution_s: float = 60.0
+    http_get: Callable[[str, dict], dict] | None = None
+
+    def _get(self, path: str, query: dict) -> dict:
+        get = self.http_get or _default_http_get(self.address)
+        return get(path, query)
+
+    def _query_vector(self, resource: int, metric: str,
+                      now_s: float) -> dict[str, float]:
+        """One usage integral per queue label, via instant query
+        (sliding) or range query from the cron reset (tumbling)."""
+        if self.params.window_type == "sliding":
+            expr = sliding_window_query(metric, now_s, self.params,
+                                        self.resolution_s)
+            doc = self._get("/api/v1/query",
+                            {"query": expr, "time": now_s})
+            rows = doc["data"]["result"]
+            return {r["metric"].get(QUEUE_LABEL, ""):
+                    float(r["value"][1]) for r in rows}
+        expr = tumbling_window_query(metric, now_s, self.params)
+        start = latest_cron_reset(self.cron_reset, now_s)
+        doc = self._get("/api/v1/query_range", {
+            "query": expr, "start": start, "end": now_s,
+            "step": self.resolution_s})
+        out: dict[str, float] = {}
+        for r in doc["data"]["result"]:
+            # the integral is the LAST sample of sum_over_time ranged
+            # from the reset (samples accumulate within the window)
+            if r["values"]:
+                out[r["metric"].get(QUEUE_LABEL, "")] = float(
+                    r["values"][-1][1])
+        return out
+
+    def fetch_usage(self, now_s: float) -> dict[str, np.ndarray]:
+        """{queue: usage [R]} — allocation integral / capacity integral
+        per resource (ref queryResourceCapacity + GetResourceUsage)."""
+        out: dict[str, np.ndarray] = {}
+        for resource, metric in self.allocation_metrics.items():
+            cap_metric = self.capacity_metrics.get(resource)
+            cap = 1.0
+            if cap_metric:
+                cap_rows = self._query_vector(resource, cap_metric, now_s)
+                cap = sum(cap_rows.values()) or 1.0
+            for queue, val in self._query_vector(
+                    resource, metric, now_s).items():
+                vec = out.setdefault(
+                    queue, np.zeros((NUM_RESOURCES,), np.float32))
+                vec[resource] = val / cap
+        return out
+
+
+class PrometheusUsageLister:
+    """Drop-in for ``UsageLister`` backed by the query layer: same
+    ``maybe_fetch``/``queue_usage`` surface the Scheduler consumes,
+    same staleness rejection (a dead Prometheus degrades to plain
+    weight-based fairness)."""
+
+    def __init__(self, client: PrometheusUsageClient):
+        self.client = client
+        self.params = client.params
+        self._last: dict[str, np.ndarray] | None = None
+        #: attempt time throttles retries (advances on FAILURE too — a
+        #: dead Prometheus must not add a blocking query per cycle);
+        #: data time drives staleness
+        self._last_attempt: float | None = None
+        self._last_data: float | None = None
+
+    def maybe_fetch(self, now: float) -> bool:
+        if (self._last_attempt is not None
+                and now - self._last_attempt < self.params.fetch_interval_s):
+            return False
+        self._last_attempt = now
+        try:
+            self._last = self.client.fetch_usage(now)
+            self._last_data = now
+            return True
+        except Exception:  # noqa: BLE001 — degrade, never stall a cycle
+            return False
+
+    def queue_usage(self, now: float) -> dict[str, np.ndarray] | None:
+        if self._last_data is None:
+            return None
+        if now - self._last_data > self.params.staleness():
+            return None  # stale pipeline: reject frozen history
+        return self._last

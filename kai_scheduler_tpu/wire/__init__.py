@@ -1,0 +1,2 @@
+"""Sidecar wire protocol: protobuf schema + codec (see sidecar.proto)."""
+from . import codec, sidecar_pb2  # noqa: F401

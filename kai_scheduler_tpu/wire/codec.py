@@ -1,0 +1,211 @@
+"""apis dataclasses ↔ sidecar protobuf messages, by reflection.
+
+The .proto mirrors ``kai_scheduler_tpu.apis.types`` field-for-field
+(see ``sidecar.proto``), so one generic descriptor-driven converter
+covers every message instead of N hand-written mappers — the proto file
+stays the single schema source and drift shows up as an AttributeError
+in the round-trip test, not as silently dropped fields.
+
+Special cases the reflection cannot infer:
+- enum fields ride as their ``.value`` (int for ``PodStatus``, string
+  for the str-enums) and are reconstructed through the enum type;
+- ``PodAffinityTerm.match_labels`` is a tuple-of-pairs in the API and a
+  proto map;
+- ``BindRequest.resource_claim_allocations`` holds claim names OR
+  legacy integer placeholders — integers are stringified on the wire.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+from ..apis import types as apis
+from ..runtime.cluster import Cluster
+from . import sidecar_pb2 as pb
+
+
+def _is_repeated(fd) -> bool:
+    # protobuf >=5: property; some versions: method; older: label enum
+    attr = getattr(fd, "is_repeated", None)
+    if attr is not None:
+        return attr() if callable(attr) else bool(attr)
+    return fd.label == fd.LABEL_REPEATED
+
+#: dataclass type per message name (only non-trivial nested types need
+#: registering; scalars and maps convert directly)
+_DATACLASS_BY_MSG = {
+    "ResourceVec": apis.ResourceVec,
+    "QueueResource": apis.QueueResource,
+    "Queue": apis.Queue,
+    "Taint": apis.Taint,
+    "Node": apis.Node,
+    "Toleration": apis.Toleration,
+    "AffinityExpr": apis.AffinityExpr,
+    "PodAffinityTerm": apis.PodAffinityTerm,
+    "TopologyConstraint": apis.TopologyConstraint,
+    "SubGroup": apis.SubGroup,
+    "PodGroup": apis.PodGroup,
+    "Pod": apis.Pod,
+    "BindRequest": apis.BindRequest,
+    "ResourceClaim": apis.ResourceClaim,
+    "DeviceClass": apis.DeviceClass,
+    "PersistentVolumeClaim": apis.PersistentVolumeClaim,
+    "StorageClass": apis.StorageClass,
+    "Topology": apis.Topology,
+    "Eviction": apis.Eviction,
+}
+
+_ENUM_FIELDS = {
+    ("Pod", "status"): apis.PodStatus,
+    ("PodGroup", "preemptibility"): apis.Preemptibility,
+    ("PodGroup", "phase"): apis.PodGroupPhase,
+    ("BindRequest", "received_resource_type"): apis.ReceivedResourceType,
+}
+
+
+def to_msg(obj, msg):
+    """Fill proto ``msg`` from dataclass ``obj`` (returns ``msg``)."""
+    mname = msg.DESCRIPTOR.name
+    for fd in msg.DESCRIPTOR.fields:
+        val = getattr(obj, fd.name)
+        if val is None:
+            continue  # optional stays unset
+        if isinstance(val, enum.Enum):
+            val = val.value
+        if (mname, fd.name) == ("PodAffinityTerm", "match_labels"):
+            getattr(msg, fd.name).update(dict(val))
+        elif (mname, fd.name) == ("BindRequest",
+                                  "resource_claim_allocations"):
+            getattr(msg, fd.name).extend(str(v) for v in val)
+        elif fd.message_type is not None and fd.message_type.GetOptions(
+                ).map_entry:
+            getattr(msg, fd.name).update(val)
+        elif _is_repeated(fd):
+            if fd.message_type is not None:
+                for item in val:
+                    to_msg(item, getattr(msg, fd.name).add())
+            else:
+                getattr(msg, fd.name).extend(val)
+        elif fd.message_type is not None:
+            to_msg(val, getattr(msg, fd.name))
+        else:
+            setattr(msg, fd.name, val)
+    return msg
+
+
+@functools.lru_cache(maxsize=None)
+def _none_default_fields(cls) -> frozenset:
+    out = set()
+    for f in dataclasses.fields(cls):
+        if f.default is None:
+            out.add(f.name)
+    return frozenset(out)
+
+
+def from_msg(msg):
+    """Proto message → apis dataclass instance."""
+    mname = msg.DESCRIPTOR.name
+    cls = _DATACLASS_BY_MSG[mname]
+    kw = {}
+    for fd in msg.DESCRIPTOR.fields:
+        if fd.has_presence and not msg.HasField(fd.name):
+            # unset presence field: None only where the dataclass says
+            # None; otherwise fall back to the dataclass default — a
+            # foreign client omitting Queue.accel or Node.allocatable
+            # must get the API defaults (UNLIMITED quotas, empty vec),
+            # never a crashing None or a proto3 zero
+            if fd.name in _none_default_fields(cls):
+                kw[fd.name] = None
+            continue
+        val = getattr(msg, fd.name)
+        if (mname, fd.name) == ("PodAffinityTerm", "match_labels"):
+            kw[fd.name] = tuple(sorted(val.items()))
+        elif (mname, fd.name) == ("BindRequest",
+                                  "resource_claim_allocations"):
+            kw[fd.name] = [int(v) if v.isdigit() else v for v in val]
+        elif fd.message_type is not None and fd.message_type.GetOptions(
+                ).map_entry:
+            kw[fd.name] = dict(val)
+        elif _is_repeated(fd):
+            if fd.message_type is not None:
+                kw[fd.name] = [from_msg(m) for m in val]
+            else:
+                kw[fd.name] = list(val)
+        elif fd.message_type is not None:
+            kw[fd.name] = from_msg(val)
+        else:
+            ecls = _ENUM_FIELDS.get((mname, fd.name))
+            kw[fd.name] = ecls(val) if ecls is not None else val
+    return cls(**kw)
+
+
+# -- document-level converters -------------------------------------------
+
+_COLLECTIONS = (
+    ("nodes", "nodes"), ("queues", "queues"),
+    ("pod_groups", "pod_groups"), ("pods", "pods"),
+    ("bind_requests", "bind_requests"),
+    ("resource_claims", "resource_claims"),
+    ("device_classes", "device_classes"),
+    ("volume_claims", "volume_claims"),
+    ("storage_classes", "storage_classes"),
+)
+
+
+def cluster_to_msg(cluster: Cluster) -> "pb.ClusterDoc":
+    doc = pb.ClusterDoc(now=cluster.now)
+    for pb_field, attr in _COLLECTIONS:
+        store = getattr(cluster, attr)
+        for obj in store.values():
+            to_msg(obj, getattr(doc, pb_field).add())
+    if cluster.topology is not None:
+        to_msg(cluster.topology, doc.topology)
+    return doc
+
+
+def cluster_from_msg(doc: "pb.ClusterDoc") -> Cluster:
+    from ..runtime.snapshot import rebuild_reservations
+    topo = from_msg(doc.topology) if doc.HasField("topology") else None
+    cluster = Cluster.from_objects(
+        [from_msg(m) for m in doc.nodes],
+        [from_msg(m) for m in doc.queues],
+        [from_msg(m) for m in doc.pod_groups],
+        [from_msg(m) for m in doc.pods],
+        topo)
+    for pb_field, attr in _COLLECTIONS[4:]:
+        store = getattr(cluster, attr)
+        for m in getattr(doc, pb_field):
+            obj = from_msg(m)
+            key = getattr(obj, "name", None) or obj.pod_name
+            store[key] = obj
+    cluster.now = doc.now
+    rebuild_reservations(cluster)
+    return cluster
+
+
+def apply_delta_msg(cluster: Cluster, delta: "pb.ClusterDelta") -> None:
+    """Apply a proto delta: upserts carry COMPLETE objects (proto3 has
+    no partial-field presence for scalars; the JSON wire keeps the
+    partial-merge form), deletes are names."""
+    for pb_field, attr in _COLLECTIONS:
+        store = getattr(cluster, attr)
+        for m in getattr(delta, f"{pb_field}_upsert"):
+            obj = from_msg(m)
+            key = getattr(obj, "name", None) or obj.pod_name
+            store[key] = obj
+        for name in getattr(delta, f"{pb_field}_delete"):
+            store.pop(name, None)
+    if delta.HasField("now"):
+        cluster.now = delta.now
+
+
+def commit_to_msg(result) -> "pb.CommitSet":
+    out = pb.CommitSet()
+    for br in result.bind_requests:
+        to_msg(br, out.bind_requests.add())
+    for ev in result.evictions:
+        to_msg(ev, out.evictions.add())
+    for k, v in result.action_seconds.items():
+        out.action_seconds[k] = v
+    return out

@@ -84,16 +84,36 @@ def test_chunked_preempt_matches_sequential(seed, departments, leaves):
             <= int(np.asarray(outs[1].victim).sum()))
 
 
+@pytest.mark.parametrize("strategy", ["binpack", "spread"])
 @pytest.mark.parametrize("seed", [0, 1, 4])
-def test_uniform_kernel_matches_per_task(seed):
+def test_uniform_kernel_matches_per_task(seed, strategy):
     """Uniform whole-gang placement ≡ the per-task loop under binpack:
     same gangs allocated, same per-gang placement counts (node choice
-    may differ only among equal-scoring nodes)."""
+    may differ only among equal-scoring nodes).  Under SPREAD the
+    whole-gang fill drifts from the per-task re-ranking by design, so
+    the Session auto-tune keeps the per-task kernel there — this test
+    pins both facts: the auto-tune gate, and that even a FORCED uniform
+    kernel under spread still admits the same gang set (only node
+    choices drift)."""
+    from kai_scheduler_tpu.ops.scoring import PlacementConfig
     nodes, queues, groups, pods, topo = make_cluster(
         num_nodes=20, node_accel=4.0, num_gangs=14, tasks_per_gang=3,
         seed=seed)
-    ses = Session.open(nodes, queues, groups, pods, topo)
-    assert ses.config.allocate.uniform_tasks  # shape qualifies
+    spread = strategy == "spread"
+    base_cfg = None
+    if spread:
+        from kai_scheduler_tpu.framework.session import SessionConfig
+        from kai_scheduler_tpu.ops.allocate import AllocateConfig
+        base_cfg = SessionConfig(allocate=AllocateConfig(
+            placement=PlacementConfig(binpack_accel=False,
+                                      binpack_cpu=False)))
+    ses = Session.open(nodes, queues, groups, pods, topo,
+                       config=base_cfg)
+    if spread:
+        # the auto-tune gate: spread shards never get the uniform kernel
+        assert not ses.config.allocate.uniform_tasks
+    else:
+        assert ses.config.allocate.uniform_tasks  # shape qualifies
     outs = {}
     for uniform in (True, False):
         cfg = dataclasses.replace(ses.config.allocate,

@@ -1,0 +1,152 @@
+"""Prometheus query-construction layer (VERDICT r3 item 8): the
+constructed PromQL matches the reference's shapes, the cron reset
+resolves, and — the parity property — a mock Prometheus backend that
+numerically evaluates the constructed queries over a synthetic
+allocation series yields the same normalized usage as the host-side
+accumulator integrating the same series.
+"""
+import numpy as np
+
+from kai_scheduler_tpu.apis.types import (NUM_RESOURCES, RESOURCE_ACCEL,
+                                          RESOURCE_CPU)
+from kai_scheduler_tpu.runtime.usagedb import UsageLister, UsageParams
+from kai_scheduler_tpu.runtime.usagedb_prometheus import (
+    QUEUE_LABEL, PrometheusUsageClient, PrometheusUsageLister,
+    decay_query, latest_cron_reset, sliding_window_query,
+    tumbling_window_query)
+
+
+def test_query_shapes_match_reference():
+    p = UsageParams(half_life_s=3600.0)
+    d = decay_query(1000.0, 3600.0)
+    assert d == "0.5^((1000 - time()) / 3600.000000)"
+    q = sliding_window_query("kai_queue_allocated_gpus", 1000.0, p)
+    assert q == ("sum_over_time((((kai_queue_allocated_gpus) * "
+                 "(0.5^((1000 - time()) / 3600.000000))))[14400s:60s])")
+    t = tumbling_window_query("kai_queue_allocated_gpus", 1000.0,
+                              UsageParams(window_type="tumbling",
+                                          half_life_s=None))
+    assert t == "sum_over_time(kai_queue_allocated_gpus)"
+
+
+def test_latest_cron_reset():
+    import datetime as dt
+    now = dt.datetime(2026, 7, 30, 15, 42,
+                      tzinfo=dt.timezone.utc).timestamp()
+    # daily at midnight
+    r = latest_cron_reset("0 0 * * *", now)
+    assert r == dt.datetime(2026, 7, 30, 0, 0,
+                            tzinfo=dt.timezone.utc).timestamp()
+    # hourly on the half hour
+    r = latest_cron_reset("30 * * * *", now)
+    assert r == dt.datetime(2026, 7, 30, 15, 30,
+                            tzinfo=dt.timezone.utc).timestamp()
+
+
+class _MockProm:
+    """Evaluates the constructed queries numerically over a synthetic
+    step series — a Prometheus stand-in for exactly the query shapes
+    this layer emits."""
+
+    def __init__(self, series, capacity, step_s=60.0):
+        #: series: {queue: {metric value at any t}} as a callable(t)
+        self.series = series
+        self.capacity = capacity
+        self.step = step_s
+
+    def _sum_over(self, fn, start, end, anchor, half_life):
+        ts = np.arange(start, end + 1e-9, self.step)
+        vals = np.asarray([fn(t) for t in ts], np.float64)
+        if half_life:
+            vals = vals * 0.5 ** ((anchor - ts) / half_life)
+        return float(vals.sum())
+
+    def __call__(self, path, query):
+        expr = query["query"]
+        # parse out our own constructions
+        half_life = None
+        if "0.5^((" in expr:
+            inner = expr.split("0.5^((", 1)[1]
+            anchor = float(inner.split(" - time()")[0])
+            half_life = float(inner.split("/ ", 1)[1].split(")")[0])
+        else:
+            anchor = 0.0
+        import re
+        metric = re.search(r"kai_[a-z_]+", expr).group(0)
+        if path == "/api/v1/query":
+            end = float(query["time"])
+            window = float(expr.rsplit("[", 1)[1].split("s:")[0])
+            start = end - window + self.step
+        else:
+            start, end = float(query["start"]), float(query["end"])
+        rows = []
+        src = (self.series if not metric.startswith("kai_cluster")
+               else {"": lambda t: self.capacity})
+        for queue, fn in src.items():
+            v = self._sum_over(fn, start, end, anchor, half_life)
+            rows.append({"metric": {QUEUE_LABEL: queue},
+                         "value": [end, str(v)],
+                         "values": [[end, str(v)]]})
+        return {"data": {"result": rows}}
+
+
+def test_parity_with_accumulator_on_synthetic_series():
+    """Same synthetic series through (a) the host accumulator and
+    (b) the mock-Prometheus query layer → same normalized usage within
+    discretization tolerance."""
+    hl = 1800.0
+    step = 60.0
+    alloc = {"qa": lambda t: 4.0 if t >= 1800 else 0.0,
+             "qb": lambda t: 2.0}
+    capacity = 8.0
+    params = UsageParams(half_life_s=hl, fetch_interval_s=step)
+
+    # (a) accumulator integrating the instantaneous series
+    acc = UsageLister(
+        client=lambda now: {
+            q: np.asarray([fn(now), 0, 0], np.float32)[:NUM_RESOURCES]
+            for q, fn in alloc.items()},
+        params=params,
+        capacity_fn=lambda now: np.asarray(
+            [capacity, 0, 0], np.float32)[:NUM_RESOURCES])
+    t = 0.0
+    while t <= 7200.0:
+        acc.fetch(t)
+        t += step
+    usage_acc = acc.queue_usage(7200.0)
+
+    # (b) the Prometheus layer against the mock backend
+    client = PrometheusUsageClient(
+        params=params,
+        allocation_metrics={RESOURCE_ACCEL: "kai_queue_allocated_gpus"},
+        capacity_metrics={RESOURCE_ACCEL: "kai_cluster_capacity_gpus"},
+        http_get=_MockProm(alloc, capacity, step),
+        resolution_s=step)
+    usage_prom = client.fetch_usage(7200.0)
+
+    for q in ("qa", "qb"):
+        a = usage_acc[q][RESOURCE_ACCEL]
+        b = usage_prom[q][RESOURCE_ACCEL]
+        assert abs(a - b) < 0.05, (q, a, b)
+    # qa used 4 GPUs for the recent half, qb 2 throughout: qa's decayed
+    # share must exceed qb's
+    assert usage_prom["qa"][RESOURCE_ACCEL] > usage_prom["qb"][RESOURCE_ACCEL]
+
+
+def test_lister_staleness_degrades():
+    client = PrometheusUsageClient(
+        http_get=lambda path, q: (_ for _ in ()).throw(OSError("down")))
+    lister = PrometheusUsageLister(client)
+    assert not lister.maybe_fetch(0.0)
+    assert lister.queue_usage(0.0) is None  # dead pipeline: no usage
+
+    ok_client = PrometheusUsageClient(
+        params=UsageParams(half_life_s=None, fetch_interval_s=60.0),
+        allocation_metrics={RESOURCE_ACCEL: "kai_queue_allocated_gpus"},
+        capacity_metrics={},
+        http_get=_MockProm({"qa": lambda t: 1.0}, 1.0))
+    lister2 = PrometheusUsageLister(ok_client)
+    assert lister2.maybe_fetch(0.0)
+    assert lister2.queue_usage(10.0) is not None
+    # past stalenessPeriod (5x fetch interval) the data is rejected
+    assert lister2.queue_usage(1000.0) is None
