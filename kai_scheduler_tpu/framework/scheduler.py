@@ -381,20 +381,37 @@ class Scheduler:
             by=float(host["attempted"].sum()))
         metrics.podgroups_scheduled.inc(
             "all", by=float(host["allocated"].sum()))
-        # arrays come from the cycle's single batched transfer; plain
-        # dict writes after, skipping unchanged gauge values to keep the
-        # cycle path O(changed)
+        # arrays come from the cycle's single batched transfer; change
+        # detection is VECTORIZED against the previous cycle's tables so
+        # the Python loop touches only cells that moved — O(changed)
+        # rather than 3·Q·R dict probes per cycle (round-3 advisor)
+        import numpy as np
         fs = host["fair_share"]
         alloc = host["queue_allocated"]
         usage = host["queue_usage"]
-        for gauge, table in ((metrics.queue_fair_share, fs),
-                             (metrics.queue_allocated, alloc),
-                             (metrics.queue_usage, usage)):
-            for qi, qname in enumerate(session.index.queue_names):
-                for ri, rname in enumerate(RESOURCE_NAMES):
-                    v = float(table[qi, ri])
-                    if gauge.value(qname, rname) != v:
-                        gauge.set(qname, rname, value=v)
+        prev = getattr(self, "_gauge_prev", None)
+        if prev is None:
+            prev = self._gauge_prev = {}
+        qnames = tuple(session.index.queue_names)
+        nq = len(qnames)
+        for key, gauge, table in (("fs", metrics.queue_fair_share, fs),
+                                  ("alloc", metrics.queue_allocated, alloc),
+                                  ("usage", metrics.queue_usage, usage)):
+            old = prev.get(key)
+            # the diff is positional, so it is only valid while index →
+            # queue-name is unchanged; any queue churn/reorder falls
+            # back to a full update (a swapped queue with a coinciding
+            # value would otherwise keep a stale series)
+            if (old is not None and old[0] == qnames
+                    and old[1].shape == table.shape):
+                rows, cols = np.nonzero(old[1] != table)
+            else:
+                rows, cols = np.nonzero(np.ones_like(table, bool))
+            for qi, ri in zip(rows.tolist(), cols.tolist()):
+                if qi < nq:
+                    gauge.set(qnames[qi], RESOURCE_NAMES[ri],
+                              value=float(table[qi, ri]))
+            prev[key] = (qnames, table.copy())
 
     def _record_fit_status(self, cluster: Cluster, session: Session,
                            result: CycleResult, host: dict) -> None:
