@@ -47,7 +47,7 @@ from ..apis.types import UNLIMITED
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .predicates import feasible_nodes, feasible_nodes_dual, node_portion
-from .scoring import (W_NOMINATED, W_TOPOLOGY, PlacementConfig,
+from .scoring import (BIG_NEG, W_NOMINATED, W_TOPOLOGY, PlacementConfig,
                       gpu_sharing_score, pick_device, score_nodes_for_task)
 
 EPS = 1e-6
@@ -217,6 +217,20 @@ def anti_defer_lanes(state: ClusterState, cand_g: jax.Array,
         & cand_valid
 
 
+def _replica_count(avail: jax.Array, req: jax.Array,
+                   mask: jax.Array) -> jax.Array:
+    """i32 [N] whole replicas of ``req`` fitting in each node's ``avail``
+    rows, zero outside ``mask`` — the ONE place the count arithmetic
+    lives (the uniform kernel's lane path and the chunk-hoisted type
+    tables must agree bit-for-bit)."""
+    pos = req > EPS
+    c = jnp.where(pos[None, :],
+                  (avail + EPS) / jnp.maximum(req, EPS)[None, :],
+                  jnp.inf)                              # [N, R]
+    c = jnp.floor(jnp.min(c, axis=-1))
+    return jnp.where(mask, jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
+
+
 def _chain_membership(parent: jax.Array, num_levels: int) -> jax.Array:
     """bool [Q, Q]: ``C[q, a]`` — queue ``a`` is ``q`` or an ancestor of
     ``q``.  Computed once per action; turns per-task ancestor walks into
@@ -339,6 +353,15 @@ class AllocateConfig:
     #: enables this when the snapshot emitted term rows
     #: (``GangState.anti_marks``); the table is sized from the state.
     anti_groups: bool = False
+    #: uniform-kernel wavefront protocol: lanes emit placements only and
+    #: the chunk reconstructs capacity deltas with K-entry sparse
+    #: scatters (False restores the dense [B, N, R] delta/cumsum accept
+    #: path — debug/A-B knob, results are identical)
+    sparse_wavefront: bool = True
+    #: hoist per-TYPE feasibility/replica-count/score tables out of the
+    #: uniform kernel's lane vmap, [Y, N] once per chunk (False restores
+    #: the per-lane computation — debug/A-B knob, results are identical)
+    hoist_type_tables: bool = True
 
 
 def _attempt_gang_in_domain(
@@ -737,7 +760,9 @@ def _attempt_gang_in_domain_uniform(
         extra_extended_releasing: jax.Array | None = None,
         banned_doms: jax.Array | None = None,
         score_bias: jax.Array | None = None,
-        topo_tables=None):
+        topo_tables=None,
+        sparse_out: bool = False,
+        type_tables_u=None):
     """Whole-gang placement for uniform-task gangs, no per-task loop.
 
     A gang whose T pending tasks are identical replicas (the dominant
@@ -799,27 +824,42 @@ def _attempt_gang_in_domain_uniform(
 
     # ---- per-node replica capacity --------------------------------------
     zero = jnp.zeros((), req.dtype)
-    fit_idle, fit_pipe = feasible_nodes_dual(
-        n, req, sel, zero, zero,
-        free=free, device_free=device_free,
-        extra_releasing=extra_releasing,
-        extra_device_releasing=extra_device_releasing, devices=False,
-        task_class=task_class)
-    fit_idle = fit_idle & domain_mask
-    fit_pipe = fit_pipe & domain_mask
 
-    def copies(avail, mask):
-        c = jnp.where(req_pos[None, :],
-                      (avail + EPS) / jnp.maximum(req, EPS)[None, :],
-                      jnp.inf)                          # [N, R]
-        c = jnp.floor(jnp.min(c, axis=-1))
-        c = jnp.where(mask, jnp.clip(c, 0.0, 1e9), 0.0).astype(jnp.int32)
-        # anti-self: one replica per node, and nodes holding a replica
-        # from a prior attempt are off-limits
+    def lane_clamp(c, mask):
+        """Per-lane adjustments on a raw replica count: domain/feasibility
+        mask, then anti-self (one replica per node; nodes holding a
+        replica from a prior attempt are off-limits)."""
+        c = jnp.where(mask, c, 0)
         c = jnp.where(one_per_node & prior_on_node, 0, c)
         return jnp.where(one_per_node, jnp.minimum(c, 1), c)
 
-    c_pipe = copies(free + n.releasing + extra_releasing, fit_pipe)  # [N]
+    if type_tables_u is not None:
+        # chunk-hoisted per-TYPE tables (see allocate()): feasibility,
+        # raw replica counts, and base scores depend only on the lane's
+        # task type and chunk-start free — the per-lane work left is
+        # gathers, masks, and the tie-jitter/top-k passes
+        ty = g.task_type[gang_idx, 0]
+        fit_idle_y, fit_pipe_y, c_idle_y, c_pipe_y, scores0_y = \
+            type_tables_u
+        fit_idle = fit_idle_y[ty] & domain_mask
+        fit_pipe = fit_pipe_y[ty] & domain_mask
+        c_pipe = lane_clamp(c_pipe_y[ty], fit_pipe)     # [N]
+    else:
+        fit_idle, fit_pipe = feasible_nodes_dual(
+            n, req, sel, zero, zero,
+            free=free, device_free=device_free,
+            extra_releasing=extra_releasing,
+            extra_device_releasing=extra_device_releasing, devices=False,
+            task_class=task_class)
+        fit_idle = fit_idle & domain_mask
+        fit_pipe = fit_pipe & domain_mask
+
+    def copies(avail, mask):
+        return lane_clamp(_replica_count(avail, req, mask), mask)
+
+    if type_tables_u is None:
+        c_pipe = copies(free + n.releasing + extra_releasing,
+                        fit_pipe)                       # [N]
 
     if config.subgroup_topology:
         # required topology level (gang-level routes through subgroup
@@ -888,7 +928,10 @@ def _attempt_gang_in_domain_uniform(
     else:
         target_out = jnp.asarray(-1, jnp.int32)
 
-    c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
+    if type_tables_u is not None:
+        c_idle = jnp.minimum(lane_clamp(c_idle_y[ty], fit_idle), c_pipe)
+    else:
+        c_idle = jnp.minimum(copies(free, fit_idle), c_pipe)
 
     if config.dense_feasibility:
         # feasibility spans the node axis (no selectors/filters/domains
@@ -908,12 +951,21 @@ def _attempt_gang_in_domain_uniform(
             jnp.float32)                                # [N]
 
     # ---- scores (one pass; locality band anchored at the best node) -----
-    extra_bands_u = tie_jitter + n.soft_scores[task_class]
-    if score_bias is not None:
-        extra_bands_u = extra_bands_u + score_bias
-    scores0 = score_nodes_for_task(
-        n, free, req, fit_idle, fit_pipe, config.placement,
-        extra=extra_bands_u)                            # [N]
+    if type_tables_u is not None:
+        # hoisted base already holds the plugin bands + soft scores for
+        # the lane's type, masked by TYPE feasibility; the lane adds its
+        # jitter/bias and re-masks for its domain restriction
+        base_u = scores0_y[ty] + tie_jitter
+        if score_bias is not None:
+            base_u = base_u + score_bias
+        scores0 = jnp.where(fit_pipe, base_u, BIG_NEG)  # [N]
+    else:
+        extra_bands_u = tie_jitter + n.soft_scores[task_class]
+        if score_bias is not None:
+            extra_bands_u = extra_bands_u + score_bias
+        scores0 = score_nodes_for_task(
+            n, free, req, fit_idle, fit_pipe, config.placement,
+            extra=extra_bands_u)                        # [N]
     best = jnp.argmax(scores0)
     topo_band = jnp.where(
         has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
@@ -957,6 +1009,13 @@ def _attempt_gang_in_domain_uniform(
         success = total_placed >= g.min_needed[gang_idx]
     else:
         success = (goal > 0) & (total_placed >= goal)
+    if sparse_out:
+        # wavefront sparse protocol: a replica's node + pipeline flag
+        # fully determine its free/bind deltas (amount = the uniform
+        # replica request), so the chunk reconstructs them from
+        # (nodes_t, pipe_t) with K-entry scatters instead of carrying
+        # dense [N, R] copies per lane through the vmap
+        return (qa2, qan2, nodes_t, pipe_t, success)
     dev_t = jnp.full((T,), -1, jnp.int32)
     # extended resources take the per-task path (snapshot builder gates
     # uniform_gangs off when any exist) — pass the pool through untouched
@@ -983,7 +1042,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
                   extra_extended_releasing: jax.Array | None = None,
                   topo_tables=None,
                   domain_mask: jax.Array | None = None,
-                  score_bias: jax.Array | None = None):
+                  score_bias: jax.Array | None = None,
+                  sparse_out: bool = False,
+                  type_tables_u=None):
     """Try to place one gang; returns tentative post-gang state + success.
 
     Topology handling (ref ``plugins/topology`` SubsetNodesFn +
@@ -1020,7 +1081,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     dmask = n.valid if domain_mask is None else (n.valid & domain_mask)
 
     def run(banned):
-        extras = ((topo_tables,) if config.uniform_tasks else ())
+        extras = ((topo_tables, sparse_out, type_tables_u)
+                  if config.uniform_tasks else ())
         return in_domain(
             state, gang_idx, free, device_free, q_alloc, q_alloc_np,
             num_levels, config, dmask, pref_doms, has_pref,
@@ -1029,6 +1091,8 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
             banned, score_bias, *extras)
 
     out = run(None)
+    if config.uniform_tasks and sparse_out:
+        return out
     if config.subgroup_topology and not config.uniform_tasks:
         # In-cycle retry over the NEXT domain: the aggregate-capacity
         # domain gate stands in for allocateSubGroupSet's per-subset
@@ -1281,15 +1345,51 @@ def allocate(
     if config.anti_groups:
         dom_static, TA = anti_domain_tables(state)
 
+    # the uniform kernel's lanes emit placements only (nodes/pipeline
+    # flags); the chunk reconstructs capacity deltas with K-entry sparse
+    # scatters instead of carrying dense [B, N, R] tensors through the
+    # vmap and the accept cumsums — the dominant HBM traffic at
+    # 10k nodes x 256 lanes
+    sparse = (config.uniform_tasks and not config.extended
+              and not config.track_devices and config.sparse_wavefront)
+    # chunk-hoisted per-TYPE tables for the uniform kernel: feasibility,
+    # raw replica counts, and plugin-band scores depend only on the
+    # lane's task TYPE and chunk-start free — computing them [Y, N] once
+    # per chunk (instead of [B, N] per lane under the vmap) leaves only
+    # gathers + tie-jitter + top-k as per-lane node-axis work
+    Yu = g.type_req.shape[0]
+    hoist_types = (config.uniform_tasks and Yu <= B
+                   and config.hoist_type_tables)
+
+    def build_type_tables(free_c, dev_c):
+        zero_t = jnp.zeros((), free_c.dtype)
+
+        def per_type(y):
+            fi, fp = feasible_nodes_dual(
+                n, g.type_req[y], g.type_selector[y], zero_t, zero_t,
+                free=free_c, device_free=dev_c, extra_releasing=extra,
+                extra_device_releasing=extra_dev, devices=False,
+                task_class=g.type_class[y])
+            reqy = g.type_req[y]
+            cp = _replica_count(free_c + n.releasing + extra, reqy, fp)
+            ci = _replica_count(free_c, reqy, fi)
+            sc = score_nodes_for_task(
+                n, free_c, reqy, fi, fp, config.placement,
+                extra=n.soft_scores[g.type_class[y]])
+            return fi, fp, ci, cp, sc
+
+        return jax.vmap(per_type)(jnp.arange(Yu))
+
     def attempt_one(gi, lane, prior, quota, dmask, free, dev, qa, qan,
-                    ext, topo_tables):
+                    ext, topo_tables, utables):
         return _attempt_gang(state, gi, free, dev, qa, qan, num_levels,
                              config, extra, extra_dev, lane, chain,
                              prior_nodes=prior, quota=quota, ext_free=ext,
                              extra_extended_releasing=init.
                              extended_releasing_extra,
                              topo_tables=topo_tables,
-                             domain_mask=dmask)
+                             domain_mask=dmask, sparse_out=sparse,
+                             type_tables_u=utables)
 
     def cond(carry):
         return jnp.any(carry[1]) & (carry[4] > 0)
@@ -1383,43 +1483,98 @@ def allocate(
                                          dom_static, cand)       # [B, N]
             dup_b = anti_defer_lanes(state, cand, cand_valid)
         else:
-            dmask_b = jnp.ones((B, n.n), bool)
+            dmask_b = None
             dup_b = jnp.zeros((B,), bool)
-        (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
-         bind_b, devbind_b, ext2_b, extbind_b) = \
-            jax.vmap(attempt_one,
-                     in_axes=(0, 0, 0, 0, 0, None, None, None, None,
-                              None, None))(
-                cand, lanes, prior_b, quota_b, dmask_b, free, dev, qa,
-                qan, ext, tables)
+        dmask_ax = None if dmask_b is None else 0
+        if dmask_b is None:
+            dmask_b = n.valid
+        utables = build_type_tables(free, dev) if hoist_types else None
+        if sparse:
+            (qa2_b, qan2_b, nodes_b, pipe_b, succ_b) = \
+                jax.vmap(attempt_one,
+                         in_axes=(0, 0, 0, 0, dmask_ax, None, None, None,
+                                  None, None, None, None))(
+                    cand, lanes, prior_b, quota_b, dmask_b, free, dev, qa,
+                    qan, ext, tables, utables)
+            devt_b = jnp.full((B, T), -1, jnp.int32)
+        else:
+            (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b,
+             succ_b, bind_b, devbind_b, ext2_b, extbind_b) = \
+                jax.vmap(attempt_one,
+                         in_axes=(0, 0, 0, 0, dmask_ax, None, None, None,
+                                  None, None, None, None))(
+                    cand, lanes, prior_b, quota_b, dmask_b, free, dev, qa,
+                    qan, ext, tables, utables)
         # a same-group duplicate lane is CONFLICT-rejected (retries next
         # chunk), never counted as a genuine fit failure
         succ_all = succ_b & cand_valid
         succ_b = succ_all & ~dup_b
 
         ok = succ_b[:, None, None]
-        d_free = jnp.where(ok, free - free2_b, 0.0)               # [B, N, R]
-        d_bind = jnp.where(ok, bind_b, 0.0)                       # [B, N, R]
         d_qa = jnp.where(ok, qa2_b - qa, 0.0)                     # [B, Q, R]
         d_qan = jnp.where(ok, qan2_b - qan, 0.0)
 
         # maximal order-prefix whose cumulative claims still fit.  Deltas
         # are non-negative, so the per-prefix feasibility flags are
         # monotone and the accept mask IS the prefix mask.
-        cum_free = jnp.cumsum(d_free, axis=0)
-        cum_bind = jnp.cumsum(d_bind, axis=0)
         cum_qa = jnp.cumsum(d_qa, axis=0)
         cum_qan = jnp.cumsum(d_qan, axis=0)
-        ok_node = jnp.all(free[None] - cum_free >= rel_floor[None],
-                          axis=(1, 2))                            # [B]
-        # bind-now claims must collectively fit the chunk-start *idle*
-        # pool: each lane computed its pipelined flags against chunk-start
-        # free, so without this a later lane could bind immediately onto
-        # capacity another lane just consumed (capacity that is really
-        # still held by terminating pods).  Rejected lanes retry next
-        # chunk and re-derive their flags against the updated pool.
-        ok_bind = jnp.all(cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
-                          axis=(1, 2))                            # [B]
+        if sparse:
+            # sparse prefix test: each accepted replica claims exactly
+            # its gang's uniform request on its node, so sort the K=B*T
+            # placement entries by node (stable -> lane-major within a
+            # node), segment-cumsum the claims, and the first lane whose
+            # cumulative claim overruns a node pool bounds the prefix.
+            req_b = g.task_req[jnp.minimum(cand, G - 1), 0]       # [B, R]
+            ent_ok = succ_b[:, None] & (nodes_b >= 0)             # [B, T]
+            node_e = jnp.where(ent_ok, nodes_b, n.n).ravel()      # [K]
+            lane_e = jnp.broadcast_to(
+                jnp.arange(B)[:, None], (B, T)).ravel()
+            perm = jnp.argsort(node_e, stable=True)
+            ns = node_e[perm]
+            lane_s = lane_e[perm]
+            req_s = jnp.where(ent_ok.ravel()[perm][:, None],
+                              req_b[lane_s], 0.0)                 # [K, R]
+            first = jnp.concatenate(
+                [jnp.ones((1,), bool), ns[1:] != ns[:-1]])
+            sidx = jax.lax.associative_scan(
+                jnp.maximum,
+                jnp.where(first, jnp.arange(ns.shape[0]), -1))
+            cs = jnp.cumsum(req_s, axis=0)
+            cum_e = cs - (cs - req_s)[sidx]       # inclusive, per node
+            nsafe = jnp.minimum(ns, n.n - 1)
+            real = ns < n.n
+            cap_pipe = (free + n.releasing + extra)[nsafe] + EPS
+            viol = jnp.any(cum_e > cap_pipe, -1) & real
+            # bind-now claims must collectively fit the chunk-start
+            # *idle* pool (pipelined flags were derived against
+            # chunk-start free) — same entries, bind amounts only
+            bind_e = (ent_ok & ~pipe_b).ravel()[perm]
+            reqb_s = jnp.where(bind_e[:, None], req_b[lane_s], 0.0)
+            csb = jnp.cumsum(reqb_s, axis=0)
+            cumb_e = csb - (csb - reqb_s)[sidx]
+            cap_bind = jnp.maximum(free, 0.0)[nsafe] + EPS
+            viol = viol | (jnp.any(cumb_e > cap_bind, -1) & real)
+            first_bad = jnp.min(jnp.where(viol, lane_s, B))
+            prefix_ok = jnp.arange(B) < first_bad                 # [B]
+        else:
+            d_free = jnp.where(ok, free - free2_b, 0.0)           # [B, N, R]
+            d_bind = jnp.where(ok, bind_b, 0.0)                   # [B, N, R]
+            cum_free = jnp.cumsum(d_free, axis=0)
+            cum_bind = jnp.cumsum(d_bind, axis=0)
+            ok_node = jnp.all(free[None] - cum_free >= rel_floor[None],
+                              axis=(1, 2))                        # [B]
+            # bind-now claims must collectively fit the chunk-start
+            # *idle* pool: each lane computed its pipelined flags against
+            # chunk-start free, so without this a later lane could bind
+            # immediately onto capacity another lane just consumed
+            # (capacity that is really still held by terminating pods).
+            # Rejected lanes retry next chunk and re-derive their flags
+            # against the updated pool.
+            ok_bind = jnp.all(
+                cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
+                axis=(1, 2))                                      # [B]
+            prefix_ok = ok_node & ok_bind
         # capacity gates re-checked jointly; queues untouched by the
         # chunk (zero delta) are exempt — they may legitimately sit over
         # limit from pre-existing allocation
@@ -1427,7 +1582,7 @@ def allocate(
                         | (cum_qa <= EPS), axis=(1, 2))
         ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff[None] + EPS)
                          | (cum_qan <= EPS), axis=(1, 2))
-        accept = ok_node & ok_bind & ok_qa & ok_qan               # [B]
+        accept = prefix_ok & ok_qa & ok_qan                       # [B]
         if config.extended:
             d_ext = jnp.where(ok, ext - ext2_b, 0.0)              # [B, N, E]
             d_extbind = jnp.where(ok, extbind_b, 0.0)
@@ -1453,7 +1608,15 @@ def allocate(
 
         take = succ_b & accept
         w = take.astype(free.dtype)
-        free = free - jnp.einsum("b,bnr->nr", w, d_free)
+        if sparse:
+            take_e = take[lane_e] & ent_ok.ravel()                # [K]
+            upd = jnp.zeros((n.n + 1, free.shape[1]), free.dtype).at[
+                node_e].add(jnp.where(take_e[:, None],
+                                      req_b[lane_e], 0.0),
+                            mode="drop")
+            free = free - upd[:n.n]
+        else:
+            free = free - jnp.einsum("b,bnr->nr", w, d_free)
         qa = qa + jnp.einsum("b,bqr->qr", w, d_qa)
         qan = qan + jnp.einsum("b,bqr->qr", w, d_qan)
         if config.track_devices:
