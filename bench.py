@@ -48,10 +48,50 @@ def _p99(times: list[float]) -> float:
 #: over batches.
 PIPELINE = int(os.environ.get("BENCH_PIPELINE", "5"))
 
+#: The harness link intermittently serves a RESULT CACHE keyed on the
+#: (program, input values) pair: re-dispatching a compiled program on
+#: byte-identical inputs can return in ~0.1 ms without executing —
+#: observed bimodally (the same 50k-pod cycle measured 0.07 ms and
+#: ~50 ms minutes apart, across fresh processes, so the key is content-
+#: based).  Every timed dispatch therefore consumes a GLOBALLY UNIQUE
+#: pre-uploaded epsilon scalar that rides the kernel's OUTPUT (never an
+#: input — perturbing solver inputs can shift loop trip counts, see
+#: bench_fairshare) so no two dispatches in the whole bench run share a
+#: cache key and the device genuinely executes each one.
+_eps_buffers: list = []
+_eps_next = 0
+
+
+def _reserve_eps(n: int) -> None:
+    """Pre-upload at least ``n`` unused epsilon scalars so the timing
+    loops never pay the H2D mid-measurement."""
+    import jax
+    import jax.numpy as jnp
+    missing = _eps_next + n - len(_eps_buffers)
+    if missing > 0:
+        base = len(_eps_buffers)
+        block = [jnp.float32((base + i) * 1e-10)
+                 for i in range(max(missing, 512))]
+        jax.block_until_ready(block)
+        _eps_buffers.extend(block)
+
+
+def _next_eps():
+    """Next never-before-used epsilon device scalar."""
+    global _eps_next
+    _reserve_eps(1)
+    buf = _eps_buffers[_eps_next]
+    _eps_next += 1
+    return buf
+
 
 def _time(fn, iters: int, pipeline: int | None = None) -> float:
+    """``fn`` must consume ``_next_eps()`` (or otherwise vary its input
+    values per call, as the e2e benches do by mutating real state) so
+    the link's result cache cannot short-circuit execution."""
     import jax
     pipeline = PIPELINE if pipeline is None else pipeline
+    _reserve_eps(iters * pipeline + 1)
     jax.block_until_ready(fn())  # compile
     times = []
     for _ in range(iters):
@@ -68,6 +108,7 @@ def _time_double_buffered(fn, iters: int) -> float:
     hides the device-link round trip behind the next solve without
     batching more than one cycle ahead."""
     import jax
+    _reserve_eps(iters + 2)
     prev = fn()
     jax.block_until_ready(prev)  # compile
     times = []
@@ -97,10 +138,14 @@ def bench_fairshare(iters: int) -> dict:
     ses = _session(num_nodes=100, node_accel=8.0, num_gangs=250,
                    tasks_per_gang=2, num_departments=2,
                    queues_per_department=4)
-    fn = functools.partial(
-        jax.jit(drf.set_fair_share, static_argnames=("num_levels",)),
-        ses.state, num_levels=2)
-    p99 = _time(fn, iters)
+
+    @jax.jit
+    def run(state, e):
+        # eps rides the OUTPUT (cache-key variation only): perturbing
+        # DRF's inputs would shift the water-fill loop's convergence
+        return drf.set_fair_share(state, num_levels=2) + e
+
+    p99 = _time(lambda: run(ses.state, _next_eps()), iters)
     return {"metric": "DRF fair-share division p99 (100 nodes, 500 pods)",
             "value": round(p99, 3), "unit": "ms",
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
@@ -120,20 +165,23 @@ def _allocate_bench(name: str, iters: int, pipeline: int | None = None,
     config = ses.config.allocate
 
     @functools.partial(jax.jit, static_argnames=())
-    def cycle(state):
+    def cycle(state, e):
         fair_share = drf.set_fair_share(state, num_levels=num_levels)
         st = state.replace(
             queues=state.queues.replace(fair_share=fair_share))
         res = allocate(st, fair_share, num_levels=num_levels, config=config)
-        return res.placements, res.allocated
+        # e rides the output so every dispatch has a distinct cache key
+        # without perturbing the solve
+        return res.placements, res.allocated, e + 1.0
 
-    placements, _ = jax.block_until_ready(cycle(ses.state))
+    placements, _, _ = jax.block_until_ready(cycle(ses.state, _next_eps()))
     placed = int((np.asarray(placements) >= 0).sum())
     if double_buffer:
-        p99 = _time_double_buffered(lambda: cycle(ses.state),
+        p99 = _time_double_buffered(lambda: cycle(ses.state, _next_eps()),
                                     max(iters * 3, 8))
     else:
-        p99 = _time(lambda: cycle(ses.state), iters, pipeline=pipeline)
+        p99 = _time(lambda: cycle(ses.state, _next_eps()), iters,
+                    pipeline=pipeline)
     total = int(np.asarray(ses.state.gangs.task_valid).sum())
     return {"metric": f"{name} ({placed}/{total} pods placed)",
             "value": round(p99, 3), "unit": "ms",
@@ -210,23 +258,65 @@ def bench_headline_full(iters: int) -> dict:
                              pipeline=1, _reuse=ses)
         rdb = _allocate_bench("per-cycle-db", max(3, iters // 2),
                               _reuse=ses, double_buffer=True)
+        floor = _measure_link_floor(
+            max(3, iters // 2),
+            shape=tuple(ses.state.gangs.task_valid.shape))
         extra["headline_per_cycle"] = {
             "p99_ms": rdb["value"],
             "sync_p99_ms": r1["value"],
-            "link_notification_ms": round(
-                max(0.0, r1["value"] - out["value"]), 1),
-            "local_chip_estimate_ms": out["value"],
+            **floor,
+            "local_chip_estimate_ms": round(
+                max(0.0, r1["value"] - floor["measured_link_floor_ms"]),
+                1),
+            "local_chip_pipelined_estimate_ms": round(
+                max(0.0, out["value"] - floor["link_dispatch_ms"]), 1),
             "note": ("p99_ms: double-buffered (dispatch N+1, gather N); "
-                     "sync_p99_ms: nothing in flight; both include the "
-                     "harness link's fixed per-sync completion-"
-                     "notification latency (link_notification_ms = "
-                     "sync - pipelined, a transport constant a local "
-                     "chip does not have); local_chip_estimate_ms is "
-                     "the pipelined solve time")}
+                     "sync_p99_ms: nothing in flight.  The link floor "
+                     "is MEASURED with a null kernel (zero device "
+                     "work, commit-sized outputs, distinct inputs so "
+                     "the link's result cache cannot serve it): "
+                     "measured_link_floor_ms = null sync p99 (the full "
+                     "per-sync constant: completion notification + "
+                     "dispatch RPC), link_dispatch_ms = null pipelined "
+                     "p99 (the per-dispatch cost even pipelined "
+                     "batches pay).  local_chip_estimate_ms = sync - "
+                     "floor; local_chip_pipelined_estimate_ms = "
+                     "headline pipelined - link_dispatch (both pure "
+                     "device-solve estimates a local chip would see)")}
     except Exception as exc:  # noqa: BLE001
         extra["headline_per_cycle"] = {"error": str(exc)[:200]}
     out["extra"] = extra
     return out
+
+
+def _measure_link_floor(iters: int, shape: tuple = (6250, 8)) -> dict:
+    """Null-kernel calibration of the harness link's completion-
+    notification constant (round-4 VERDICT item 3): a trivial jitted
+    kernel producing commit-sized outputs (the cycle's [G, T] i32
+    placements + [G] allocated shapes) is timed sync (nothing in
+    flight) and pipelined.  The device work is ~zero either way, so
+    their difference is the fixed per-sync cost of OBSERVING completion
+    through the link — a transport constant a local chip does not pay.
+    ``local_chip_estimate_ms`` is then derived as measured sync minus
+    this measured floor instead of being asserted."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def null_cycle(x):
+        return (jnp.zeros(shape, jnp.float32) + x,
+                jnp.zeros(shape[:1], jnp.float32) + x)
+
+    sync = _time(lambda: null_cycle(_next_eps()), max(3, iters),
+                 pipeline=1)
+    piped = _time(lambda: null_cycle(_next_eps()), max(3, iters))
+    # null_sync is the FULL per-sync link constant (completion
+    # notification + per-dispatch RPC); null_pipelined isolates the
+    # per-dispatch component that even pipelined batches pay
+    return {"null_sync_p99_ms": round(sync, 3),
+            "null_pipelined_p99_ms": round(piped, 3),
+            "measured_link_floor_ms": round(sync, 1),
+            "link_dispatch_ms": round(piped, 1)}
 
 
 def bench_reclaim(iters: int) -> dict:
@@ -245,15 +335,15 @@ def bench_reclaim(iters: int) -> dict:
     config = ses.config.victims
 
     @functools.partial(jax.jit)
-    def cycle(state):
+    def cycle(state, e):
         res = run_victim_action(
             state, state.queues.fair_share, init_result(state),
             num_levels=num_levels, mode="reclaim", config=config)
-        return res.victim, res.allocated
+        return res.victim, res.allocated, e + 1.0
 
-    victims, _ = jax.block_until_ready(cycle(ses.state))
+    victims, _, _ = jax.block_until_ready(cycle(ses.state, _next_eps()))
     n_vic = int(np.asarray(victims).sum())
-    p99 = _time(lambda: cycle(ses.state), iters)
+    p99 = _time(lambda: cycle(ses.state, _next_eps()), iters)
     return {"metric": ("reclaim victim-search p99 @ 10k nodes x 50k pods "
                        f"({n_vic} victims)"),
             "value": round(p99, 3), "unit": "ms",

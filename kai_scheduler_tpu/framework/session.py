@@ -146,7 +146,8 @@ class Session:
                     config.allocate, track_devices=devices,
                     uniform_tasks=uniform, subgroup_topology=sub_topo,
                     extended=ext, dense_feasibility=dense,
-                    anti_groups=index.has_anti_groups),
+                    anti_groups=index.has_anti_groups,
+                    attract_groups=index.has_attract_groups),
                 victims=dataclasses.replace(
                     config.victims,
                     chunk_reclaim=not index.has_reclaim_minruntime,
@@ -154,7 +155,8 @@ class Session:
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
                         extended=ext, dense_feasibility=dense,
-                        anti_groups=index.has_anti_groups)))
+                        anti_groups=index.has_anti_groups,
+                        attract_groups=index.has_attract_groups)))
         fair_share = _set_fair_share_jit(
             state, num_levels=config.num_levels,
             k_value=jnp.float32(config.k_value))

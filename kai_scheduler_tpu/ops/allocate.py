@@ -217,6 +217,56 @@ def anti_defer_lanes(state: ClusterState, cand_g: jax.Array,
         & cand_valid
 
 
+def attract_allow_nodes(state: ClusterState, anti_used: jax.Array,
+                        dom_static: jax.Array, gang_idx: jax.Array):
+    """bool [..., N] — nodes permitted by the gang's attraction (need)
+    rows: EVERY need row must claim the node's domain at the row's
+    level, either statically (a running match, ``attract_static``) or
+    in-cycle (an anchor gang placed this cycle marked it).  Gangs
+    without need slots pass everywhere.  Shared by the allocate
+    wavefront and the victim placements (ref upstream InterPodAffinity
+    against virtually-allocated state,
+    ``k8s_internal/predicates/predicates.go:70-140``)."""
+    g = state.gangs
+    L = state.nodes.topology.shape[1]
+    TA = g.anti_term_level.shape[0]
+    assert TA > 0, "attract kernels compiled without terms"
+    needs = g.attract_needs[jnp.maximum(gang_idx, 0)]      # [..., KP]
+    t_safe = jnp.clip(needs, 0, TA - 1)
+    lvl = g.anti_term_level[t_safe]
+    doms = dom_static[jnp.clip(lvl, 0, L)]                 # [..., KP, N]
+    claimed = (anti_used[t_safe[..., None], doms]
+               | g.attract_static[t_safe])                 # [..., KP, N]
+    ok = claimed | (needs < 0)[..., None]                  # unused pass
+    return jnp.all(ok, axis=-2)                            # [..., N]
+
+
+def attract_defer_lanes(state: ClusterState, cand_g: jax.Array,
+                        cand_valid: jax.Array, anti_used: jax.Array):
+    """bool [B] — lanes with a still-UNCLAIMED need row that an EARLIER
+    valid lane of this chunk would mark: they sit the chunk out and
+    retry against the updated table (so an anchor and its depender
+    arriving in one chunk land in order instead of the depender failing
+    terminally).  Lane 0 never defers, preserving the wavefront's
+    progress guarantee."""
+    g = state.gangs
+    TA = g.anti_term_level.shape[0]
+    AD = anti_used.shape[1] - 1
+    B = cand_g.shape[0]
+    needs = g.attract_needs[jnp.maximum(cand_g, 0)]        # [B, KP]
+    marks = g.anti_marks[jnp.maximum(cand_g, 0)]           # [B, KT]
+    row_any = (jnp.any(anti_used[:TA, :AD], axis=1)
+               | jnp.any(g.attract_static, axis=1))        # [TA]
+    open_need = (needs >= 0) & ~row_any[jnp.clip(needs, 0, TA - 1)]
+    inter = jnp.any(
+        (needs[:, None, :, None] == marks[None, :, None, :])
+        & open_need[:, None, :, None]
+        & (marks >= 0)[None, :, None, :], axis=(2, 3))     # [B, B]
+    earlier = jnp.arange(B)[None, :] < jnp.arange(B)[:, None]
+    return jnp.any(inter & earlier & cand_valid[None, :], axis=1) \
+        & cand_valid
+
+
 def _replica_count(avail: jax.Array, req: jax.Array,
                    mask: jax.Array) -> jax.Array:
     """i32 [N] whole replicas of ``req`` fitting in each node's ``avail``
@@ -353,6 +403,13 @@ class AllocateConfig:
     #: enables this when the snapshot emitted term rows
     #: (``GangState.anti_marks``); the table is sized from the state.
     anti_groups: bool = False
+    #: enforce in-cycle ATTRACTION terms (required positive affinity
+    #: toward a gang placed earlier this cycle): gangs with
+    #: ``GangState.attract_needs`` slots place only on nodes whose
+    #: domains are claimed in every need row (running matches pre-marked
+    #: in ``attract_static``; anchors mark through the shared
+    #: ``anti_marks`` machinery).  Requires ``anti_groups``.
+    attract_groups: bool = False
     #: uniform-kernel wavefront protocol: lanes emit placements only and
     #: the chunk reconstructs capacity deltas with K-entry sparse
     #: scatters (False restores the dense [B, N, R] delta/cumsum accept
@@ -1351,7 +1408,11 @@ def allocate(
     # vmap and the accept cumsums — the dominant HBM traffic at
     # 10k nodes x 256 lanes
     sparse = (config.uniform_tasks and not config.extended
-              and not config.track_devices and config.sparse_wavefront)
+              and not config.track_devices and config.sparse_wavefront
+              # measured: sparse lanes lose to the dense path when the
+              # required-topology domain machinery is active (the
+              # hoisted domain caps already carry the dense tensors)
+              and not config.subgroup_topology)
     # chunk-hoisted per-TYPE tables for the uniform kernel: feasibility,
     # raw replica counts, and plugin-band scores depend only on the
     # lane's task TYPE and chunk-start free — computing them [Y, N] once
@@ -1482,6 +1543,14 @@ def allocate(
             dmask_b = ~anti_forbid_nodes(state, res.anti_used,
                                          dom_static, cand)       # [B, N]
             dup_b = anti_defer_lanes(state, cand, cand_valid)
+            if config.attract_groups:
+                # a lane with need rows is confined to claimed domains;
+                # one whose unclaimed need an earlier lane would mark
+                # retries next chunk against the updated table
+                dmask_b = dmask_b & attract_allow_nodes(
+                    state, res.anti_used, dom_static, cand)
+                dup_b = dup_b | attract_defer_lanes(
+                    state, cand, cand_valid, res.anti_used)
         else:
             dmask_b = None
             dup_b = jnp.zeros((B,), bool)

@@ -65,7 +65,8 @@ from . import ordering
 from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
                        _attempt_gang, _chain_membership, anti_defer_lanes,
                        anti_domain_tables, anti_forbid_nodes,
-                       anti_mark_placements, init_result)
+                       anti_mark_placements, attract_allow_nodes,
+                       attract_defer_lanes, init_result)
 from .scoring import W_OWN_FREED
 
 EPS = 1e-6
@@ -1055,6 +1056,11 @@ def _run_victim_action_chunked(
             dmask_b = ~anti_forbid_nodes(state, res.anti_used,
                                          dom_static, cand_g)     # [B, N]
             dup_b = anti_defer_lanes(state, cand_g, cand_valid)
+            if pcfg.attract_groups:
+                dmask_b = dmask_b & attract_allow_nodes(
+                    state, res.anti_used, dom_static, cand_g)
+                dup_b = dup_b | attract_defer_lanes(
+                    state, cand_g, cand_valid, res.anti_used)
         else:
             dmask_b = jnp.ones((B, n.n), bool)
             dup_b = jnp.zeros((B,), bool)
@@ -1282,6 +1288,9 @@ def run_victim_action(
 
         dmask = (~anti_forbid_nodes(state, res.anti_used, dom_static, gi)
                  if anti else None)
+        if anti and config.placement.attract_groups:
+            dmask = dmask & attract_allow_nodes(
+                state, res.anti_used, dom_static, gi)
 
         def attempt(_):
             return solve_for_preemptor(

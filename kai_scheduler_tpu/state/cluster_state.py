@@ -222,6 +222,18 @@ class GangState(struct.PyTreeNode):
     anti_avoids: jax.Array        # i32 [G, KT]
     #: topology level per term row (num_topo_levels = per-node)
     anti_term_level: jax.Array    # i32 [TA]
+    #: IN-CYCLE attraction (required POSITIVE affinity toward a gang
+    #: that places earlier this cycle — upstream InterPodAffinity over
+    #: virtually-allocated session state): need rows in the SAME
+    #: claimed-domain table.  A gang with need slots may only place on
+    #: nodes whose domain (at the row's level) is claimed in EVERY need
+    #: row — statically by a running match (``attract_static``) or
+    #: in-cycle by an anchor gang's placement (anchors carry the row in
+    #: ``anti_marks``; the marking machinery is shared).  -1 = unused.
+    attract_needs: jax.Array      # i32 [G, KP]
+    #: statically-satisfied nodes per table row (running matches at
+    #: snapshot build), OR-ed with the in-cycle claims — bool [TA, N]
+    attract_static: jax.Array     # bool [TA, N]
 
     @property
     def g(self) -> int:
@@ -292,9 +304,10 @@ class ClusterState(struct.PyTreeNode):
 # Padding helpers
 # ---------------------------------------------------------------------------
 
-#: in-cycle exclusion term slots per gang (marks/avoids each); terms
-#: beyond the cap fall back to next-cycle convergence via the filter
-#: masks (documented staleness, bounded and deterministic)
+#: MINIMUM in-cycle exclusion term slots per gang (marks/avoids each);
+#: the snapshot builder widens the slot dimension (bucketed to powers of
+#: two) whenever a gang carries more distinct terms, so no term is ever
+#: dropped — only the compiled shape changes
 ANTI_SLOTS = 4
 
 
@@ -303,6 +316,12 @@ def _round_up(n: int, multiple: int = 8) -> int:
     if n <= 0:
         return multiple
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def _pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n — the shared slot/row bucketing, so
+    count drift across cycles rarely changes a compiled shape."""
+    return 1 << max(0, n - 1).bit_length()
 
 
 #: leader-role label values — ref plugins/kubeflow (job-role master/
@@ -349,6 +368,8 @@ class SnapshotIndex:
     #: host port shared by >=2 pending gangs): the placement wavefronts
     #: track their claimed domains in-cycle (AllocateConfig.anti_groups)
     has_anti_groups: bool = False
+    #: attraction need rows exist (same-cycle required positive affinity)
+    has_attract_groups: bool = False
     #: emitted term-row count (the anti_used table's row dimension is
     #: sized from the state arrays; this is informational)
     num_anti_groups: int = 0
@@ -633,6 +654,7 @@ def build_snapshot(
         anti_self_level=np.full((G,), -1, np.int32),
         anti_marks=np.full((G, ANTI_SLOTS), -1, np.int32),
         anti_avoids=np.full((G, ANTI_SLOTS), -1, np.int32),
+        attract_needs=np.full((G, 2), -1, np.int32),
         task_type=np.zeros((G, T), np.int32),
         sig=np.zeros((G,), np.int32),
         task_extended=np.zeros((G, T, E), np.float32),
@@ -770,6 +792,8 @@ def build_snapshot(
         len(pod_groups)) if pod_groups else np.zeros((0,), np.int64)
     nf = len(all_pend)
     anti_term_level = np.zeros((0,), np.int32)
+    attract_static = np.zeros((0, node_topo.shape[0]), bool)
+    incycle_pos_terms: set = set()
     task_type_index: dict[tuple, int] = {}
     if nf:
         gidx = np.repeat(np.arange(len(pod_groups)), counts)
@@ -876,14 +900,20 @@ def build_snapshot(
         # each gang's required anti terms + label dicts, then emit
         # symmetric rows / forward+reverse row pairs / port rows
         terms_by_gang: dict[int, set] = {}
+        pos_by_gang: dict[int, set] = {}
         for j in np.nonzero(paff)[0].tolist():
             i = gidx[j]
             for term in all_pend[j].pod_affinity:
-                if term.required and term.anti:
-                    lvl = (topo_levels.index(term.topology_key)
-                           if term.topology_key in topo_levels else L)
+                if not term.required:
+                    continue
+                lvl = (topo_levels.index(term.topology_key)
+                       if term.topology_key in topo_levels else L)
+                if term.anti:
                     terms_by_gang.setdefault(i, set()).add(
                         (term.match_labels, lvl))
+                else:
+                    pos_by_gang.setdefault(i, set()).add(
+                        (term.match_labels, term.topology_key, lvl))
         ports_by_gang: dict[int, set] = {}
         port_counts: dict[int, dict] = {}
         for j, p in enumerate(all_pend):
@@ -904,9 +934,18 @@ def build_snapshot(
                 cur = gk["anti_self_level"][i]
                 gk["anti_self_level"][i] = L if cur < 0 else min(cur, L)
         all_terms = sorted({t for s in terms_by_gang.values() for t in s})
-        if all_terms:
-            term_keys = {k for ml, _ in all_terms for k, _ in ml}
-            labels_by_gang: dict[int, list] = {}
+        pos_terms = sorted({t for s in pos_by_gang.values() for t in s})
+        labels_by_gang: dict[int, list] = {}
+        # per-gang FULL pending label list (anchor strictness check:
+        # every pod of an anchor gang must match the term selector)
+        pend_labels_all: dict[int, list] = {}
+        if pos_terms:
+            for j, p in enumerate(all_pend):
+                pend_labels_all.setdefault(gidx[j], []).append(
+                    p.labels or {})
+        if all_terms or pos_terms:
+            term_keys = ({k for ml, _ in all_terms for k, _ in ml}
+                         | {k for ml, _, _ in pos_terms for k, _ in ml})
             for j, p in enumerate(all_pend):
                 if p.labels and term_keys & p.labels.keys():
                     labels_by_gang.setdefault(gidx[j], [])
@@ -918,7 +957,7 @@ def build_snapshot(
 
         def _slot(d, i, row):
             lst = d.setdefault(i, [])
-            if row not in lst and len(lst) < ANTI_SLOTS:
+            if row not in lst:
                 lst.append(row)
 
         for ml, lvl in all_terms:
@@ -962,6 +1001,119 @@ def build_snapshot(
             for i in carriers:
                 _slot(marks_of, i, row)
                 _slot(avoids_of, i, row)
+        # attraction rows — required POSITIVE affinity with a PENDING
+        # anchor (upstream InterPodAffinity over virtually-allocated
+        # session state, ``k8s_internal/predicates/predicates.go:70-140``).
+        # A term the carrier gang ITSELF matches folds into the
+        # required-topology machinery (co-locate the gang in one domain
+        # at the term's level — the upstream greedy where every pod
+        # joins the first pod's virtual domain); carriers that do NOT
+        # match get a need row they must find claimed at placement time:
+        # statically by a running match (``attract_static``) or in-cycle
+        # by an anchor gang's placement (anchors carry the row in
+        # ``anti_marks``).  Terms handled in-cycle are excluded from the
+        # static filter fold (``incycle_pos_terms``).
+        needs_of: dict[int, list] = {}
+        attract_rows: list[tuple[int, tuple, int]] = []
+
+        def _running_match(ml) -> bool:
+            return any(
+                rp.status != apis.PodStatus.RELEASING
+                and node_idx0.get(rp.node, -1) >= 0
+                and all(rp.labels.get(k) == v for k, v in ml)
+                for rp in running_pods)
+
+        for ml, tkey, lvl in pos_terms:
+            carriers = {i for i, ts in pos_by_gang.items()
+                        if (ml, tkey, lvl) in ts}
+            matchers = {i for i, lds in labels_by_gang.items()
+                        if any(all(ld.get(k) == v for k, v in ml)
+                               for ld in lds)}
+            if not matchers:
+                continue  # no pending anchor — the static fold decides
+            # levels are outermost-first, so the STRICTER of two
+            # required-colocation levels is the FINER one (max index —
+            # one host implies one rack); contrast anti_self_level,
+            # where coarser (min) is stricter for spreading
+            self_skipped = False
+            rm = _running_match(ml)
+            for i in carriers & matchers:
+                # self-anchored: the gang's own pods satisfy the term by
+                # co-locating in one domain at the term's level.  With
+                # running matches present the gang must still JOIN a
+                # matched domain (static fold, or the need row below
+                # when a depender row disables the fold); without, the
+                # fold is skipped (the k8s self-match bootstrap rule).
+                # Hostname-level self-affinity stays with the static
+                # masks (next-cycle convergence).
+                if lvl < L:
+                    cur = gk["required_level"][i]
+                    gk["required_level"][i] = (lvl if cur < 0
+                                               else max(cur, lvl))
+                    for si in range(S):
+                        csg = gk["subgroup_required_level"][i, si]
+                        gk["subgroup_required_level"][i, si] = (
+                            lvl if csg < 0 else max(csg, lvl))
+                    if not rm:
+                        incycle_pos_terms.add((ml, tkey))
+                        self_skipped = True
+            dependers = carriers - matchers
+            # anchors must mark ONLY domains that will hold a matching
+            # pod, but marking is gang-granular (anti_mark_placements
+            # claims EVERY placed task's domain) — so only gangs whose
+            # pending pods ALL match the selector may anchor; a
+            # mixed-label matcher stays out (its dependers converge
+            # next cycle via the running-match masks, never a violation)
+            anchors = {i for i in matchers
+                       if all(all(ld.get(k) == v for k, v in ml)
+                              for ld in pend_labels_all.get(i, []))}
+            # a need row is emitted whenever dependers exist and the
+            # term is handled in-cycle — including the anchor-less case
+            # where a SELF-fold already skipped the shared static fold
+            # (the row then confines dependers to running-match domains,
+            # restoring exactly what the skipped fold enforced)
+            if not dependers or not (anchors or self_skipped):
+                continue
+            row = len(rows)
+            rows.append(lvl)
+            for i in anchors:
+                _slot(marks_of, i, row)
+            # the row disables the shared static fold for EVERY pod
+            # carrying the term, so carrier∩matcher gangs whose fold was
+            # load-bearing get the need row as well: hostname-level
+            # selfs (no node-granular fold exists) and folded selfs
+            # with running matches (the fold also forced them INTO a
+            # matched domain — the row's attract_static restores that
+            # exactly, and in-cycle anchors extend it).  Only folded
+            # selfs with NO running match go row-free: the k8s
+            # self-match bootstrap lets them open a fresh domain.
+            needy_selfs = {i for i in carriers & matchers
+                           if lvl >= L or rm}
+            for i in dependers | needy_selfs:
+                lst = needs_of.setdefault(i, [])
+                if row not in lst:
+                    lst.append(row)
+            incycle_pos_terms.add((ml, tkey))
+            attract_rows.append((row, ml, lvl))
+        needp = max((len(lst) for lst in needs_of.values()), default=0)
+        if needp > gk["attract_needs"].shape[1]:
+            Gp = gk["attract_needs"].shape[0]
+            gk["attract_needs"] = np.full((Gp, _pow2_ceil(needp)), -1,
+                                          np.int32)
+        for i, lst in needs_of.items():
+            gk["attract_needs"][i, :len(lst)] = lst
+        # size the slot dimension from the snapshot: every distinct term
+        # row a gang carries gets a slot (dropping one would unenforce a
+        # required anti term for a cycle, and binds are permanent).  The
+        # dim is bucketed to powers of two >= ANTI_SLOTS so term-count
+        # drift across cycles rarely changes the compiled shape.
+        need = max((len(lst) for d in (marks_of, avoids_of)
+                    for lst in d.values()), default=0)
+        if need > ANTI_SLOTS:
+            slots = _pow2_ceil(need)
+            Gp = gk["anti_marks"].shape[0]
+            gk["anti_marks"] = np.full((Gp, slots), -1, np.int32)
+            gk["anti_avoids"] = np.full((Gp, slots), -1, np.int32)
         for i, lst in marks_of.items():
             gk["anti_marks"][i, :len(lst)] = lst
         for i, lst in avoids_of.items():
@@ -973,9 +1125,28 @@ def build_snapshot(
         # recompile every cycle.  Padded rows are never referenced (no
         # gang's marks/avoids point at them).
         if rows:
-            padded = 1 << max(0, len(rows) - 1).bit_length()
-            rows = rows + [0] * (padded - len(rows))
+            rows = rows + [0] * (_pow2_ceil(len(rows)) - len(rows))
         anti_term_level = np.asarray(rows, np.int32)
+        # statically-satisfied nodes per attract row: the domains (at
+        # the row's level) that already hold a RUNNING match — OR-ed
+        # with the in-cycle claims at placement time
+        attract_static = np.zeros((len(rows), node_topo.shape[0]), bool)
+        for row, ml, lvl in attract_rows:
+            for rp in running_pods:
+                if rp.status == apis.PodStatus.RELEASING:
+                    continue
+                ni = node_idx0.get(rp.node, -1)
+                if ni < 0 or not all(
+                        rp.labels.get(k) == v for k, v in ml):
+                    continue
+                if lvl < L:
+                    d = node_topo[ni, lvl]
+                    if d >= 0:
+                        attract_static[row] |= node_topo[:, lvl] == d
+                    else:
+                        attract_static[row, ni] = True
+                else:
+                    attract_static[row, ni] = True
 
     # --- running pods -----------------------------------------------------
     # Pods whose node is missing from the snapshot (cordoned/deleted) keep
@@ -1285,7 +1456,7 @@ def build_snapshot(
         if pod.status != apis.PodStatus.RELEASING]
     filter_masks, soft_scores = node_filters.evaluate_filter_classes(
         filter_specs, spec_pods, live_nodes, node_topo, topo_levels,
-        running_views, N)
+        running_views, N, incycle_pos_terms=frozenset(incycle_pos_terms))
 
     # --- kernel-config hints derived from the snapshot shape --------------
     has_fracs = bool(gk["task_portion"].any() or gk["task_accel_mem"].any()
@@ -1354,7 +1525,8 @@ def build_snapshot(
             preempt_min_runtime_eff=_f(np.asarray(q_preempt_eff, dtype)),
             reclaim_min_runtime_eff=_f(np.asarray(q_reclaim_eff, dtype)),
         ),
-        gangs=GangState(**gk, anti_term_level=anti_term_level),
+        gangs=GangState(**gk, anti_term_level=anti_term_level,
+                        attract_static=attract_static),
         running=RunningState(**rk),
     )
     state = jax.device_put(state)
@@ -1377,6 +1549,7 @@ def build_snapshot(
         has_reclaim_minruntime=bool((q_reclaim_mrt > 0).any()),
         has_anti_groups=len(anti_term_level) > 0,
         num_anti_groups=len(anti_term_level),
+        has_attract_groups=bool((gk["attract_needs"] >= 0).any()),
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

@@ -38,11 +38,14 @@ exclusion-term rows the snapshot emits (``GangState.anti_marks`` /
 ``anti_avoids``) and the cycle's claimed-domain table
 (``AllocationResult.anti_used``), which ALL placement actions honour:
 the allocate wavefront and the victim actions' placements alike (see
-``AllocateConfig.anti_groups``).  What remains snapshot-stale for one
-cycle: asymmetric required POSITIVE affinity toward a gang placed in
-the same cycle (the depender fails its feasibility prefilter and
-converges next cycle — conservative, never a constraint violation),
-and gangs whose term count exceeds the ``ANTI_SLOTS`` cap.
+``AllocateConfig.anti_groups``).  The slot dimension is sized from the
+snapshot (every distinct term row gets a slot — see ``ANTI_SLOTS``),
+so no exclusion term is ever dropped.  Required POSITIVE affinity
+toward a gang placed in the same cycle is enforced through ATTRACTION
+rows in the same table (``GangState.attract_needs``): the depender's
+static fold is lifted and it may only place into domains a running
+match or an in-cycle anchor claimed (``AllocateConfig.attract_groups``),
+so anchor + depender arriving in one cycle co-land.
 """
 from __future__ import annotations
 
@@ -135,6 +138,7 @@ def evaluate_filter_classes(
     topo_levels: list[str],
     running: list[_RunningPodView],
     num_nodes_padded: int,
+    incycle_pos_terms: frozenset = frozenset(),
 ) -> tuple[np.ndarray, np.ndarray]:
     """Evaluate every distinct spec against every node.
 
@@ -238,7 +242,13 @@ def evaluate_filter_classes(
                         counts[d] += 1
             node_counts = np.where(doms >= 0, counts[np.maximum(doms, 0)], 0)
             if required:
-                mask &= (node_counts == 0) if anti else (node_counts > 0)
+                if anti:
+                    mask &= node_counts == 0
+                elif (match_labels, topology_key) not in incycle_pos_terms:
+                    mask &= node_counts > 0
+                # else: a PENDING anchor exists — enforced through the
+                # cycle's claimed-domain table (GangState.attract_needs;
+                # running matches pre-marked in attract_static)
             else:
                 pref_aff += (-node_counts if anti
                              else node_counts).astype(np.float32)

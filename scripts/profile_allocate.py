@@ -23,11 +23,17 @@ def build(num_nodes=10_000, num_gangs=6250, tasks_per_gang=8, **kw):
 
 
 def timeit(fn, iters=8, pipeline=5):
-    jax.block_until_ready(fn())
+    """``fn(eps)``: eps must ride the output so every dispatch has a
+    distinct cache key (the harness link serves a content-keyed result
+    cache for repeated identical dispatches — see bench._next_eps)."""
+    eps = [jnp.float32(i * 1e-10) for i in range(iters * pipeline + 1)]
+    jax.block_until_ready(eps)
+    seq = iter(eps)
+    jax.block_until_ready(fn(next(seq)))
     best = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready([fn() for _ in range(pipeline)])
+        jax.block_until_ready([fn(next(seq)) for _ in range(pipeline)])
         best.append((time.perf_counter() - t0) / pipeline)
     return np.median(best) * 1e3, np.percentile(best, 99) * 1e3
 
@@ -58,29 +64,30 @@ def main():
             config = dataclasses.replace(config, **{k: val})
 
     @jax.jit
-    def cycle(state):
+    def cycle(state, e):
         fair_share = drf.set_fair_share(state, num_levels=num_levels)
         st = state.replace(
             queues=state.queues.replace(fair_share=fair_share))
         res = allocate(st, fair_share, num_levels=num_levels, config=config)
-        return res.placements, res.allocated
+        return res.placements, res.allocated, e + 1.0
 
-    lowered = cycle.lower(ses.state)
+    lowered = cycle.lower(ses.state, jnp.float32(0.0))
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0]
     print("flops", ca.get("flops"), "bytes", ca.get("bytes accessed"))
 
-    placements, alloc = jax.block_until_ready(cycle(ses.state))
+    placements, alloc, _ = jax.block_until_ready(
+        cycle(ses.state, jnp.float32(0.0)))
     placed = int((np.asarray(placements) >= 0).sum())
-    med, p99 = timeit(lambda: cycle(ses.state))
+    med, p99 = timeit(lambda e: cycle(ses.state, e))
     print(f"placed={placed} median={med:.2f}ms p99={p99:.2f}ms")
 
     @jax.jit
-    def drf_only(state):
-        return drf.set_fair_share(state, num_levels=num_levels)
-    med, p99 = timeit(lambda: drf_only(ses.state))
+    def drf_only(state, e):
+        return drf.set_fair_share(state, num_levels=num_levels) + e
+    med, p99 = timeit(lambda e: drf_only(ses.state, e))
     print(f"drf only: median={med:.2f}ms p99={p99:.2f}ms")
 
 

@@ -444,3 +444,305 @@ class TestInCycleExclusion:
         ha_nodes = [placed[gi][placed[gi] >= 0][0] for gi in ha_rows]
         assert len(res.evictions) >= 2, res.evictions
         assert len(ha_nodes) == 2 and ha_nodes[0] != ha_nodes[1], ha_nodes
+
+    def test_six_terms_widen_slots_no_silent_drop(self):
+        """A gang carrying SIX distinct required anti terms gets every
+        term enforced in-cycle: the snapshot widens the slot dimension
+        to fit (``ANTI_SLOTS`` is a floor, not a cap), so overflow terms
+        are never silently dropped (round-4 VERDICT weak 3).
+
+        Ref ``k8s_internal/predicates/predicates.go:70-140`` — upstream
+        evaluates EVERY term of every pod, with no term-count cap."""
+        terms = [apis.PodAffinityTerm(match_labels=(("app", f"a{i}"),),
+                                      anti=True, required=True)
+                 for i in range(6)]
+        groups = [apis.PodGroup(name=f"l{i}", queue="q", min_member=1)
+                  for i in range(6)]
+        groups.append(apis.PodGroup(name="hub", queue="q", min_member=1))
+        pods = [apis.Pod(name=f"l{i}-0", group=f"l{i}",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": f"a{i}"}) for i in range(6)]
+        pods.append(apis.Pod(name="hub-0", group="hub",
+                             resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                             pod_affinity=terms))
+        # 1-accel nodes: every pod owns a node, so each label gang
+        # lands somewhere distinct and hub must dodge ALL six
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(1.0, 64.0, 256.0),
+                           labels={"kubernetes.io/hostname": f"n{i}"})
+                 for i in range(8)]
+        state, _ = build_snapshot(nodes, self._queues(), groups, pods, None)
+        # hub needs >= 6 slots in each direction -> widened to 8
+        assert state.gangs.anti_marks.shape[1] == 8, \
+            state.gangs.anti_marks.shape
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 7, by_pod
+        label_nodes = {v for k, v in by_pod.items() if k != "hub-0"}
+        assert by_pod["hub-0"] not in label_nodes, by_pod
+
+
+class TestInCycleAttraction:
+    """Same-cycle required POSITIVE affinity (round-4 VERDICT item 5):
+    a depender whose required positive term matches a gang placed
+    earlier this cycle gets its feasibility restricted to the anchor's
+    claimed domain instead of failing the prefilter — anchor and
+    depender arriving in ONE cycle co-land.
+
+    Ref ``k8s_internal/predicates/predicates.go:70-140`` (InterPodAffinity
+    evaluated per task against virtually-allocated session state)."""
+
+    @staticmethod
+    def _queues(quota=64.0):
+        return [apis.Queue(name="dept", accel=apis.QueueResource(quota=quota)),
+                apis.Queue(name="q", parent="dept",
+                           accel=apis.QueueResource(quota=quota))]
+
+    def test_anchor_and_depender_coland_same_node(self):
+        """web requires app=db on its node; db and web arrive in one
+        cycle (db first in creation order) -> both place, co-located."""
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(8.0, 64.0, 256.0),
+                           labels={"kubernetes.io/hostname": f"n{i}"})
+                 for i in range(4)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),))
+        groups = [apis.PodGroup(name="db", queue="q", min_member=1),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name="db-0", group="db",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"}),
+                apis.Pod(name="web-0", group="web",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         pod_affinity=[term])]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 2, by_pod
+        assert by_pod["web-0"] == by_pod["db-0"], by_pod
+
+    def test_anchor_and_depender_coland_same_rack(self):
+        """Rack-level positive term: the depender lands in the anchor's
+        rack (not necessarily its node) in the same cycle."""
+        topo = apis.Topology("t", levels=["rack", "host"])
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(2.0, 64.0, 256.0),
+                           labels={"rack": f"r{i // 3}", "host": f"n{i}"})
+                 for i in range(9)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),),
+                                    topology_key="rack")
+        groups = [apis.PodGroup(name="db", queue="q", min_member=1),
+                  apis.PodGroup(name="web", queue="q", min_member=2)]
+        pods = [apis.Pod(name="db-0", group="db",
+                         resources=apis.ResourceVec(2.0, 1.0, 1.0),
+                         labels={"app": "db"})]
+        # 2 accel each: the rack's other nodes must host the dependers
+        pods += [apis.Pod(name=f"web-{i}", group="web",
+                          resources=apis.ResourceVec(2.0, 1.0, 1.0),
+                          pod_affinity=[term]) for i in range(2)]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, topo)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 3, by_pod
+        rack = {n: f"r{i // 3}" for i, n in
+                enumerate(f"n{j}" for j in range(9))}
+        anchor_rack = rack[by_pod["db-0"]]
+        assert rack[by_pod["web-0"]] == anchor_rack, by_pod
+        assert rack[by_pod["web-1"]] == anchor_rack, by_pod
+
+    def test_depender_without_anchor_fails_cleanly(self):
+        """No running or placeable pending match -> the depender does
+        not place (and does not land somewhere arbitrary)."""
+        nodes = [apis.Node(name="n0",
+                           allocatable=apis.ResourceVec(8.0, 64.0, 256.0))]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),))
+        # the anchor gang exists but its pod cannot fit (9 accel > 8)
+        groups = [apis.PodGroup(name="db", queue="q", min_member=1),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name="db-0", group="db",
+                         resources=apis.ResourceVec(9.0, 1.0, 1.0),
+                         labels={"app": "db"}),
+                apis.Pod(name="web-0", group="web",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         pod_affinity=[term])]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert "web-0" not in by_pod, by_pod
+
+    def test_depender_joins_running_match_statically(self):
+        """A RUNNING match and a pending anchor coexist: the depender
+        may use either domain (static marks pre-fill the table)."""
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(3.0, 64.0, 256.0))
+                 for i in range(3)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),))
+        groups = [apis.PodGroup(name="run", queue="q", min_member=1,
+                                last_start_timestamp=0.0),
+                  apis.PodGroup(name="db", queue="q", min_member=1),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name="run-0", group="run",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"},
+                         status=apis.PodStatus.RUNNING, node="n0"),
+                apis.Pod(name="db-0", group="db",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"}),
+                apis.Pod(name="web-0", group="web",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         pod_affinity=[term])]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 2, by_pod
+        assert by_pod["web-0"] in ("n0", by_pod["db-0"]), by_pod
+
+    def test_self_match_bootstrap_colocates(self):
+        """A gang whose own pods match its positive rack-level term
+        places all pods in ONE rack (the upstream greedy: every pod
+        joins the first pod's virtual domain), even with no other
+        match anywhere."""
+        topo = apis.Topology("t", levels=["rack", "host"])
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(1.0, 64.0, 256.0),
+                           labels={"rack": f"r{i // 2}", "host": f"n{i}"})
+                 for i in range(6)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "peer"),),
+                                    topology_key="rack")
+        groups = [apis.PodGroup(name="peers", queue="q", min_member=2)]
+        pods = [apis.Pod(name=f"peer-{i}", group="peers",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "peer"}, pod_affinity=[term])
+                for i in range(2)]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, topo)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 2, by_pod
+        racks = {int(n[1:]) // 2 for n in by_pod.values()}
+        assert len(racks) == 1, by_pod
+
+    def test_mixed_label_anchor_never_violates(self):
+        """An anchor gang whose pods do NOT all match the selector may
+        not anchor (marking is gang-granular, so a mixed gang would
+        claim domains without a matching pod).  The depender defers to
+        next-cycle convergence instead of binding beside a non-match."""
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(1.0, 64.0, 256.0))
+                 for i in range(4)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),))
+        groups = [apis.PodGroup(name="mixed", queue="q", min_member=2),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name="mixed-0", group="mixed",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"}),
+                apis.Pod(name="mixed-1", group="mixed",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0)),
+                apis.Pod(name="web-0", group="web",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         pod_affinity=[term])]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        # 1-accel nodes: if web placed at all it must share mixed-0's
+        # node (the only node that will hold an app=db pod) — with
+        # 1 accel per node that is impossible, so web must NOT place
+        assert "web-0" not in by_pod, by_pod
+
+    def test_self_fold_keeps_stricter_required_level(self):
+        """A rack-level self-affinity term must not LOOSEN an explicit
+        host-level required topology constraint (stricter = finer)."""
+        topo = apis.Topology("t", levels=["rack", "host"])
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(4.0, 64.0, 256.0),
+                           labels={"rack": f"r{i // 2}", "host": f"n{i}"})
+                 for i in range(4)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "peer"),),
+                                    topology_key="rack")
+        groups = [apis.PodGroup(
+            name="peers", queue="q", min_member=3,
+            topology_constraint=apis.TopologyConstraint(
+                topology="t", required_level="host"))]
+        pods = [apis.Pod(name=f"peer-{i}", group="peers",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "peer"}, pod_affinity=[term])
+                for i in range(3)]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, topo)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        assert len(by_pod) == 3, by_pod
+        assert len(set(by_pod.values())) == 1, by_pod
+
+    def test_hostname_self_affinity_with_depender_not_weakened(self):
+        """A hostname-level self-affine gang coexisting with a depender
+        gang must not lose its own enforcement (the attract row
+        disables the shared static fold; the self gang gets a need row
+        instead): with nothing claimed anywhere, NEITHER may place
+        spread across empty hosts."""
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(1.0, 64.0, 256.0))
+                 for i in range(4)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),))
+        groups = [apis.PodGroup(name="db", queue="q", min_member=2),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name=f"db-{i}", group="db",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"}, pod_affinity=[term])
+                for i in range(2)]
+        pods.append(apis.Pod(name="web-0", group="web",
+                             resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                             pod_affinity=[term]))
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, None)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        # 1-accel nodes: db's pods can never share a host, so a correct
+        # scheduler binds NOTHING of db (all-or-nothing) and web has no
+        # matching host to join
+        assert not by_pod, by_pod
+
+    def test_self_anchor_with_running_match_must_join_domain(self):
+        """A self-anchored gang with a RUNNING match must still join a
+        matched domain even when a depender row disables the shared
+        static fold: with the matched rack full, the gang stays pending
+        instead of opening a fresh rack (upstream InterPodAffinity)."""
+        topo = apis.Topology("t", levels=["rack", "host"])
+        nodes = [apis.Node(name=f"n{i}",
+                           allocatable=apis.ResourceVec(1.0, 64.0, 256.0),
+                           labels={"rack": f"r{i // 2}", "host": f"n{i}"})
+                 for i in range(4)]
+        term = apis.PodAffinityTerm(match_labels=(("app", "db"),),
+                                    topology_key="rack")
+        groups = [apis.PodGroup(name="run", queue="q", min_member=1,
+                                last_start_timestamp=0.0),
+                  apis.PodGroup(name="fill", queue="q", min_member=1,
+                                last_start_timestamp=0.0),
+                  apis.PodGroup(name="selfg", queue="q", min_member=1),
+                  apis.PodGroup(name="web", queue="q", min_member=1)]
+        pods = [apis.Pod(name="run-0", group="run",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"},
+                         status=apis.PodStatus.RUNNING, node="n0"),
+                apis.Pod(name="fill-0", group="fill",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         status=apis.PodStatus.RUNNING, node="n1"),
+                apis.Pod(name="self-0", group="selfg",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         labels={"app": "db"}, pod_affinity=[term]),
+                apis.Pod(name="web-0", group="web",
+                         resources=apis.ResourceVec(1.0, 1.0, 1.0),
+                         pod_affinity=[term])]
+        cluster = Cluster.from_objects(nodes, self._queues(), groups,
+                                       pods, topo)
+        res = Scheduler().run_once(cluster)
+        by_pod = {b.pod_name: b.selected_node for b in res.bind_requests}
+        # rack r0 (the only app=db rack) is full: nothing may bind in
+        # r1, where no matching pod exists
+        assert not by_pod, by_pod
