@@ -459,6 +459,11 @@ class DeviceClass:
     #: device must have at least this much memory (CEL
     #: ``device.capacity['memory']`` comparisons)
     min_memory_gib: float = 0.0
+    #: this class allocates ACCELERATOR devices (counts toward the accel
+    #: request and the queue's gpu quota); False = a non-gpu device
+    #: class, ignored by the accel accounting (ref allocate_dra_test.go
+    #: "non gpu claims doesn't count for gpu limit")
+    accel: bool = True
     #: node-label constraints (CEL node attribute selectors)
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
 
@@ -477,6 +482,26 @@ class ResourceClaim:
     node: str | None = None
     devices: list[int] = dataclasses.field(default_factory=list)
     owner_pod: str | None = None
+    #: claim labels — SHARED gpu claims must carry the pod's queue under
+    #: ``kai.scheduler/queue`` (ref dynamicresources.go
+    #: validateSharedGpuClaimQueueLabel)
+    labels: dict = dataclasses.field(default_factory=dict)
+    #: created from a ResourceClaimTemplate (per-pod): exempt from the
+    #: shared-claim queue-label rule
+    from_template: bool = True
+    #: existing consumers in Status.ReservedFor — the scheduler may not
+    #: admit pods past ``RESERVED_FOR_MAX`` total (ref
+    #: dynamicresources.go preFilter)
+    reserved_for: int = 0
+
+
+#: resource.k8s.io ResourceClaimReservedForMaxSize — the consumer cap a
+#: claim may never exceed (ref dynamicresources.go:149)
+RESERVED_FOR_MAX = 256
+
+#: queue label key shared claims must carry (ref common/constants
+#: DefaultQueueLabel)
+QUEUE_LABEL = "kai.scheduler/queue"
 
 
 @dataclasses.dataclass

@@ -127,6 +127,11 @@ class Session:
         state, index = build_snapshot(
             nodes, queues, pod_groups, pods, topology, **snapshot_kwargs)
         if config.auto_tune:
+            # a hierarchy deeper than the configured recursion would
+            # leave leaf levels undivided — widen to the snapshot depth
+            if index.max_queue_depth + 1 > config.num_levels:
+                config = dataclasses.replace(
+                    config, num_levels=index.max_queue_depth + 1)
             devices = index.needs_device_table
             # the whole-gang kernel is exactly the sequential greedy
             # under BINPACK scoring only (a filling node's score rises,

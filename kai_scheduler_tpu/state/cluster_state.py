@@ -370,6 +370,9 @@ class SnapshotIndex:
     has_anti_groups: bool = False
     #: attraction need rows exist (same-cycle required positive affinity)
     has_attract_groups: bool = False
+    #: deepest queue depth (0 = flat) — Session widens its division
+    #: recursion to cover the whole hierarchy
+    max_queue_depth: int = 1
     #: emitted term-row count (the anti_used table's row dimension is
     #: sized from the state arrays; this is informational)
     num_anti_groups: int = 0
@@ -676,24 +679,57 @@ def build_snapshot(
     spec_pods: dict[tuple, apis.Pod] = {
         node_filters.EMPTY_SPEC: apis.Pod("", "")}
 
-    def dra_of(pod: apis.Pod) -> tuple[int, tuple]:
+    #: consumers admitted this snapshot per claim name — dra_of runs
+    #: once per pending pod in intake order, so the counter mirrors the
+    #: reference's virtual ReservedFor growth within a cycle
+    claim_admitted: dict[str, int] = {}
+
+    def dra_of(pod: apis.Pod,
+               queue_name: str | None = None) -> tuple[int, tuple]:
         """(device count, resolved DeviceClass constraint key) — real
         ResourceClaim objects drive the count and the node constraints
         (ref dynamicresources.go claim→deviceclass selection); bare
-        ``dra_accel_count`` keeps the legacy unconstrained behavior."""
+        ``dra_accel_count`` keeps the legacy unconstrained behavior.
+        Non-accel device classes keep their node constraints but skip
+        the accel accounting ("non gpu claims doesn't count for gpu
+        limit").
+
+        With ``queue_name`` (pending pods only) the upstream draPlugin
+        preFilter gates apply (``dynamicresources.go:139-160``): a pod
+        whose claim already has ``RESERVED_FOR_MAX`` consumers (existing
+        + earlier pending referents this cycle — the virtual ReservedFor
+        growth) never schedules, and a SHARED (non-template) GPU claim
+        must carry the pod's queue under the ``kai.scheduler/queue``
+        label.  Violations resolve to an unsatisfiable node constraint,
+        so the gang stays pending with a feasibility fit error — the
+        tensor analogue of the reference's preFilter error."""
         if not pod.resource_claims or not resource_claims:
             return pod.dra_accel_count, ()
-        cnt, min_mem = 0, 0.0
+        cnt, min_mem, bad = 0, 0.0, False
         sels: list[tuple[str, str]] = []
         for cname in pod.resource_claims:
             claim = resource_claims.get(cname)
             if claim is None:
                 continue
-            cnt += claim.count
             dc = (device_classes or {}).get(claim.device_class)
+            is_accel = dc is None or dc.accel
+            if queue_name is not None:
+                taken = claim.reserved_for + claim_admitted.get(cname, 0)
+                bad_label = (is_accel and not claim.from_template
+                             and claim.labels.get(apis.QUEUE_LABEL)
+                             != queue_name)
+                if taken >= apis.RESERVED_FOR_MAX or bad_label:
+                    bad = True
+                else:
+                    claim_admitted[cname] = \
+                        claim_admitted.get(cname, 0) + 1
             if dc is not None:
                 min_mem = max(min_mem, dc.min_memory_gib)
                 sels.extend(sorted(dc.node_selector.items()))
+            if is_accel:
+                cnt += claim.count
+        if bad:
+            return cnt, (float("inf"), ())
         key = (min_mem, tuple(sels)) if (min_mem or sels) else ()
         return cnt, key
 
@@ -816,8 +852,8 @@ def build_snapshot(
 
         # distinct task specs: one dict probe per pod, everything heavier
         # once per distinct type
-        def _tkey(p: apis.Pod) -> tuple:
-            dra_cnt, dra_key = dra_of(p)
+        def _tkey(p: apis.Pod, qname: str) -> tuple:
+            dra_cnt, dra_key = dra_of(p, queue_name=qname)
             return (
                 p.resources.as_tuple(),
                 tuple(sorted(p.node_selector.items()))
@@ -827,8 +863,10 @@ def build_snapshot(
                 tuple(sorted(p.extended.items())) if p.extended else ())
 
         tid = np.fromiter(
-            (task_type_index.setdefault(_tkey(p), len(task_type_index))
-             for p in all_pend), np.int64, nf)
+            (task_type_index.setdefault(
+                _tkey(p, pod_groups[gidx[j]].queue),
+                len(task_type_index))
+             for j, p in enumerate(all_pend)), np.int64, nf)
         Yn = len(task_type_index)
         t_req = np.zeros((Yn, R), np.float32)
         t_sel = np.full((Yn, K), -1, np.int32)
@@ -1550,6 +1588,7 @@ def build_snapshot(
         has_anti_groups=len(anti_term_level) > 0,
         num_anti_groups=len(anti_term_level),
         has_attract_groups=bool((gk["attract_needs"] >= 0).any()),
+        max_queue_depth=int(q_depth.max(initial=0)),
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

@@ -47,6 +47,8 @@ class Q:
     name: str
     quota: float = -1.0               # UNLIMITED by default
     limit: float = -1.0
+    cpu_quota: float = -1.0
+    cpu_limit: float = -1.0
     priority: int = 0
     parent: str | None = None
     preempt_min_runtime: float = 0.0
@@ -78,6 +80,12 @@ class G:
     subgroup_of: list | None = None   # per-task subgroup names
     topology: tuple | None = None     # (required_level, preferred_level)
     devices: list | None = None       # running pods' device ids (fractions)
+    claims: list = dataclasses.field(default_factory=list)
+    #: per-task claim-name lists (overrides ``claims``, which every
+    #: task shares)
+    claims_of: list | None = None
+    #: running pods are RELEASING (being deleted) instead of RUNNING
+    releasing: bool = False
 
 
 @dataclasses.dataclass
@@ -103,6 +111,24 @@ class Case:
     expect_disjoint: list = dataclasses.field(default_factory=list)
     #: pairs of gangs that MUST share at least one node/domain
     expect_colocated: list = dataclasses.field(default_factory=list)
+    #: DRA objects (apis.ResourceClaim / apis.DeviceClass)
+    resource_claims: list = dataclasses.field(default_factory=list)
+    device_classes: list = dataclasses.field(default_factory=list)
+    #: node -> expected IDLE accel in the snapshot (pre-action), and
+    #: node -> expected RELEASING accel — the reference's
+    #: ``ExpectedNodesResources`` (test_utils.go IdleGPUs/ReleasingGPUs)
+    expect_node_idle: dict = dataclasses.field(default_factory=dict)
+    expect_node_releasing: dict = dataclasses.field(default_factory=dict)
+    #: scheduler cycles to run before asserting — the reference's
+    #: ``RoundsUntilMatch`` (multi-cycle convergence: evictions land,
+    #: then consolidation/allocate use the freed capacity).  expect /
+    #: expect_nodes / expect_pipelined read the FINAL cycle's tensors;
+    #: expect_evictions counts across all cycles.
+    rounds: int = 1
+    #: action pipeline override — the reference's per-suite action
+    #: config (allocate_test.go runs allocate ONLY; the victim suites
+    #: configure their action sets).  None = the full default pipeline.
+    actions: tuple | None = None
 
 
 #: cluster clock for scenario runs — running gangs' start stamps are
@@ -122,15 +148,27 @@ def _build(case: Case):
             accel_memory_gib=ns.gpu_mem_gib or 16.0,
             extended=dict(ns.mig)))
     specs = case.queues or [Q("q0")]
-    parents = {qs.parent for qs in specs if qs.parent}
-    queues = [apis.Queue(name=p) for p in sorted(parents)]
-    if not parents:
-        queues.append(apis.Queue(name="dept"))
+    spec_names = {qs.name for qs in specs}
+    # a spec may itself be another spec's parent (multi-level
+    # hierarchies); only parents nobody spec'd get bare Queue objects
+    # un-spec'd parents impose no cap of their own (accel quota defaults
+    # to 0 = nothing deserved, which would starve every non-preemptible
+    # descendant at the ancestor gate)
+    parents = {qs.parent for qs in specs if qs.parent} - spec_names
+    queues = [apis.Queue(name=p, accel=apis.QueueResource(quota=-1.0))
+              for p in sorted(parents)]
+    need_dept = any(not qs.parent for qs in specs)
+    if need_dept:
+        queues.append(apis.Queue(name="dept",
+                                 accel=apis.QueueResource(quota=-1.0)))
     for qs in specs:
         queues.append(apis.Queue(
-            name=qs.name, parent=qs.parent or "dept",
+            name=qs.name,
+            parent=qs.parent or ("dept" if need_dept else None),
             priority=qs.priority,
             accel=apis.QueueResource(quota=qs.quota, limit=qs.limit),
+            cpu=apis.QueueResource(quota=qs.cpu_quota,
+                                   limit=qs.cpu_limit),
             preempt_min_runtime=qs.preempt_min_runtime,
             reclaim_min_runtime=qs.reclaim_min_runtime))
     groups, pods = [], []
@@ -164,10 +202,13 @@ def _build(case: Case):
                 labels=dict(gs.labels),
                 pod_affinity=list(gs.affinity),
                 extended=dict(gs.mig),
+                resource_claims=list(gs.claims_of[t] if gs.claims_of
+                                     else gs.claims),
                 subgroup=(gs.subgroup_of[t]
                           if gs.subgroup_of else None))
             if running:
-                pod.status = apis.PodStatus.RUNNING
+                pod.status = (apis.PodStatus.RELEASING if gs.releasing
+                              else apis.PodStatus.RUNNING)
                 pod.node = gs.on[t % len(gs.on)]
                 if gs.devices:
                     pod.accel_devices = [gs.devices[t % len(gs.devices)]]
@@ -178,14 +219,58 @@ def _build(case: Case):
                                     levels=(case.topology_levels
                                             + ["kubernetes.io/hostname"]))
                                  if case.topology_levels else None))
+    for claim in case.resource_claims:
+        cluster.resource_claims[claim.name] = claim
+    for dc in case.device_classes:
+        cluster.device_classes[dc.name] = dc
     cluster.now = _NOW
     return cluster
 
 
 def run_case(case: Case):
     cluster = _build(case)
-    sched = Scheduler()
+    if case.expect_node_idle or case.expect_node_releasing:
+        # the reference's ExpectedNodesResources count WHOLE devices
+        # (node_info: a shared device is IDLE only when fully free,
+        # RELEASING only when every holder is releasing) — derived here
+        # from the snapshot's device table, the repo's source of truth
+        # for shared-device occupancy
+        from kai_scheduler_tpu.state import build_snapshot
+        state, idx = build_snapshot(
+            list(cluster.nodes.values()), list(cluster.queues.values()),
+            list(cluster.pod_groups.values()), list(cluster.pods.values()),
+            cluster.topology, resource_claims=cluster.resource_claims,
+            device_classes=cluster.device_classes)
+        ni = {nm: i for i, nm in enumerate(idx.node_names)}
+        dev_free = np.asarray(state.nodes.device_free)
+        dev_rel = np.asarray(state.nodes.device_releasing)
+        counts = {ns.name: int(round(ns.gpu)) for ns in case.nodes}
+        for node, want in case.expect_node_idle.items():
+            d = counts[node]
+            got = int((dev_free[ni[node], :d] >= 1.0 - 1e-6).sum())
+            assert got == want, (
+                f"{case.name}: {node} idle devices {got}, expected "
+                f"{want} (ref {case.ref})")
+        for node, want in case.expect_node_releasing.items():
+            d = counts[node]
+            fr, rl = dev_free[ni[node], :d], dev_rel[ni[node], :d]
+            got = int(((rl > 1e-6) & (fr + rl >= 1.0 - 1e-6)).sum())
+            assert got == want, (
+                f"{case.name}: {node} releasing devices {got}, "
+                f"expected {want} (ref {case.ref})")
+    if case.actions is not None:
+        from kai_scheduler_tpu.framework.scheduler import SchedulerConfig
+        sched = Scheduler(SchedulerConfig(actions=tuple(case.actions)))
+    else:
+        sched = Scheduler()
     res = sched.run_once(cluster)
+    n_evictions = len(res.evictions)
+    for _ in range(case.rounds - 1):
+        # releasing pods reap (or restart) between cycles, as the
+        # reference's multi-round runner lets the cluster converge
+        cluster.tick(1.0)
+        res = sched.run_once(cluster)
+        n_evictions += len(res.evictions)
     # gang -> (placed count, node names, pipelined count)
     placed = {b.pod_name.rsplit("-", 1)[0]: [] for b in res.bind_requests}
     for b in res.bind_requests:
@@ -214,8 +299,8 @@ def run_case(case: Case):
             f"{case.name}: {gang} on {ns}, allowed {allowed} "
             f"(ref {case.ref})")
     if case.expect_evictions is not None:
-        assert len(res.evictions) == case.expect_evictions, (
-            f"{case.name}: {len(res.evictions)} evictions, expected "
+        assert n_evictions == case.expect_evictions, (
+            f"{case.name}: {n_evictions} evictions, expected "
             f"{case.expect_evictions} (ref {case.ref})")
     for gang, minp in case.expect_pipelined.items():
         got = int(pipe[rows[gang]].sum())

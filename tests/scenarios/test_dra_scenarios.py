@@ -1,0 +1,265 @@
+"""DRA (dynamic resource allocation) scenario catalog — the analogue of
+the reference's 14-case DRA allocate suite
+(``actions/integration_tests/allocate/allocate_dra_test.go``, case names
+quoted in each ``ref``) plus the draPlugin preFilter rules
+(``plugins/dynamicresources/dynamicresources.go:126-195``): claim
+consumer caps (``ResourceClaimReservedForMaxSize``) and the shared-claim
+queue-label validation.
+"""
+import pytest
+
+from kai_scheduler_tpu.apis import types as apis
+
+from .harness import Case, G, N, Q, run_case
+
+QL = apis.QUEUE_LABEL
+MAX = apis.RESERVED_FOR_MAX
+
+
+def shared_claim(name, queue=None, count=1, reserved=0, labels=None,
+                 device_class="gpu"):
+    lab = dict(labels or {})
+    if queue is not None:
+        lab[QL] = queue
+    return apis.ResourceClaim(
+        name=name, device_class=device_class, count=count,
+        from_template=False, reserved_for=reserved, labels=lab)
+
+
+def template_claim(name, count=1, device_class="gpu"):
+    return apis.ResourceClaim(name=name, device_class=device_class,
+                              count=count, from_template=True)
+
+
+GPU_CLASS = apis.DeviceClass(name="gpu")
+
+CASES = [
+    Case(
+        name="dra_no_claim_schedules_normally",
+        ref='allocate_dra_test.go: "Simple pod with no resource claim"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=1)],
+        expect={"j0": True},
+        expect_nodes={"j0": {"n0"}},
+    ),
+    Case(
+        name="dra_shared_claim_correct_queue_label",
+        ref='allocate_dra_test.go: "Simple pod with simple resource '
+            'claim with correct queue label"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0")],
+        device_classes=[GPU_CLASS],
+        expect={"j0": True},
+        expect_nodes={"j0": {"n0"}},
+    ),
+    Case(
+        name="dra_claim_requests_too_many_devices",
+        ref='allocate_dra_test.go: "Simple pod with simple resource '
+            'claim - requesting too many devices"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=8)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0", count=2)],
+        device_classes=[GPU_CLASS],
+        # 2 devices claimed, the only node has 1: never schedulable
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_node_bound_devices_force_separate_nodes",
+        ref='allocate_dra_test.go: "2 pods requesting node-bound '
+            'device, can\'t schedule on same node"',
+        nodes=[N("n0", gpu=1), N("n1", gpu=1)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("ja", tasks=1, gpu=0, claims=["ca"]),
+               G("jb", tasks=1, gpu=0, claims=["cb"])],
+        resource_claims=[shared_claim("ca", queue="q0"),
+                         shared_claim("cb", queue="q0")],
+        device_classes=[GPU_CLASS],
+        expect={"ja": True, "jb": True},
+        expect_disjoint=[("ja", "jb")],
+    ),
+    Case(
+        name="dra_two_claims_two_nodes",
+        ref='allocate_dra_test.go: "2 simple pods with simple resource '
+            'claims, allocating on separate nodes"',
+        nodes=[N("n0", gpu=1), N("n1", gpu=1)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("j0", tasks=2, gpu=0, min_member=2,
+                 claims_of=[["c0"], ["c1"]])],
+        resource_claims=[shared_claim("c0", queue="q0"),
+                         shared_claim("c1", queue="q0")],
+        device_classes=[GPU_CLASS],
+        expect={"j0": True},
+        expect_nodes={"j0": {"n0", "n1"}},
+    ),
+    Case(
+        name="dra_exactly_at_max_consumers",
+        ref='allocate_dra_test.go: "Exactly at claim max consumers '
+            'limit"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0",
+                                      reserved=MAX - 1)],
+        device_classes=[GPU_CLASS],
+        expect={"j0": True},
+        expect_nodes={"j0": {"n0"}},
+    ),
+    Case(
+        name="dra_partially_over_max_consumers",
+        ref='allocate_dra_test.go: "Partially over claim max consumers '
+            'limit"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("j0", tasks=2, gpu=0, min_member=2, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0",
+                                      reserved=MAX - 1)],
+        device_classes=[GPU_CLASS],
+        # the first referent takes the claim's last consumer slot, the
+        # second is rejected at the cap — the all-or-nothing gang stays
+        # whole and pending (upstream: the second pod's preFilter fails)
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_already_at_max_consumers",
+        ref='allocate_dra_test.go: "Claim already reached max '
+            'consumers limit"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0", reserved=MAX)],
+        device_classes=[GPU_CLASS],
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_shared_claim_missing_queue_label",
+        ref='allocate_dra_test.go: "Shared claim with no queue label - '
+            'blocked from scheduling"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0")],  # no queue label
+        device_classes=[GPU_CLASS],
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_shared_claim_wrong_queue_label",
+        ref='allocate_dra_test.go: "Shared claim with wrong queue '
+            'label - blocked from scheduling"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="other-queue")],
+        device_classes=[GPU_CLASS],
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_template_claim_exempt_from_queue_label",
+        ref='dynamicresources.go validateSharedGpuClaimQueueLabel: '
+            '"Template claims are created per-pod and don\'t need '
+            'queue validation"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[template_claim("c0")],
+        device_classes=[GPU_CLASS],
+        expect={"j0": True},
+    ),
+    Case(
+        name="dra_claim_over_quota_nonpreemptible",
+        ref='allocate_dra_test.go: "pod with simple resource claim - '
+            'requests over quota as non-preemptable"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=2, gpu=0, min_member=2, preemptible=False,
+                 claims_of=[["ca"], ["cb"]])],
+        resource_claims=[shared_claim("ca", queue="q0"),
+                         shared_claim("cb", queue="q0")],
+        device_classes=[GPU_CLASS],
+        # 2 claimed devices > 1 deserved: a non-preemptible job may not
+        # exceed quota
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_claim_over_limit",
+        ref='allocate_dra_test.go: "pod with simple resource claim - '
+            'requests over limit"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=2, limit=1)],
+        gangs=[G("j0", tasks=2, gpu=0, min_member=2,
+                 claims_of=[["ca"], ["cb"]])],
+        resource_claims=[shared_claim("ca", queue="q0"),
+                         shared_claim("cb", queue="q0")],
+        device_classes=[GPU_CLASS],
+        expect={"j0": 0},
+    ),
+    Case(
+        name="dra_cap_admits_partial_independent_referents",
+        ref='dynamicresources.go preFilter: virtual ReservedFor growth '
+            '— the consumer cap rejects only the overflow referent, '
+            'not every referent',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("ja", tasks=1, gpu=0, claims=["c0"]),
+               G("jb", tasks=1, gpu=0, claims=["c0"])],
+        resource_claims=[shared_claim("c0", queue="q0", count=1,
+                                      reserved=MAX - 1)],
+        device_classes=[GPU_CLASS],
+        # two INDEPENDENT 1-pod gangs share the claim's last slot: the
+        # first admits, the second stays pending
+        expect={"ja": True, "jb": 0},
+    ),
+    Case(
+        name="dra_non_accel_class_keeps_node_constraints",
+        ref='allocate_dra_test.go non-gpu claims + deviceclass node '
+            'selection: an accel=False class still pins the pod to '
+            'nodes that HAVE the device',
+        nodes=[N("n0", gpu=1), N("n1", gpu=1,
+                                 labels={"rdma": "true"})],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["nic0"])],
+        resource_claims=[shared_claim("nic0", queue="q0",
+                                      device_class="rdma-nic")],
+        device_classes=[GPU_CLASS,
+                        apis.DeviceClass(name="rdma-nic", accel=False,
+                                         node_selector={"rdma": "true"})],
+        expect={"j0": True},
+        expect_nodes={"j0": {"n1"}},
+    ),
+    Case(
+        name="dra_non_accel_shared_claim_exempt_from_queue_label",
+        ref='dynamicresources.go validateSharedGpuClaimQueueLabel: the '
+            'queue-label rule scopes to GPU claims '
+            '(IsGpuResourceClaim)',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["nic0"])],
+        resource_claims=[shared_claim("nic0",  # no queue label
+                                      device_class="rdma-nic")],
+        device_classes=[apis.DeviceClass(name="rdma-nic", accel=False)],
+        expect={"j0": True},
+    ),
+    Case(
+        name="dra_non_gpu_claim_not_counted",
+        ref='allocate_dra_test.go: "pod with simple resource claim - '
+            'non gpu claims doesn\'t count for gpu limit"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=0, limit=0)],
+        gangs=[G("j0", tasks=1, gpu=0, claims=["nic0"])],
+        resource_claims=[shared_claim("nic0", queue="q0",
+                                      device_class="rdma-nic")],
+        device_classes=[GPU_CLASS,
+                        apis.DeviceClass(name="rdma-nic", accel=False)],
+        # the claim's devices are not accelerators: a zero-gpu queue
+        # still schedules it
+        expect={"j0": True},
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_dra_scenario(case):
+    run_case(case)

@@ -1,0 +1,386 @@
+"""Allocate-order / quota-gate / hierarchy / fractional-reclaim scenario
+catalog — reference-traceable to
+``actions/integration_tests/allocate/allocate_test.go`` (allowances,
+over-quota rules, creation/priority order, share updates mid-round,
+hierarchy depths), ``.../reclaim`` (fractional and MIG reclaim), and
+``.../preempt/preemptGang_test.go`` (whole-gang victimhood).
+
+Priority/preemptibility encoding follows the reference's classes:
+train = priority 50 preemptible, build/interactive = priority 100
+non-preemptible (``constants.PriorityTrainNumber`` /
+``PriorityBuildNumber``).
+"""
+import pytest
+
+from .harness import Case, G, N, Q, run_case
+
+CASES = [
+    # ---- allowances and over-quota rules (allocate_test.go) -------------
+    Case(
+        name="department_allowance_caps_children",
+        ref='allocate_test.go: "allocate job but does not allow to '
+            'department to go over allowance"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("dept0", limit=2),
+                Q("qa", parent="dept0"), Q("qb", parent="dept0")],
+        gangs=[G("a0", queue="qa", tasks=2, gpu=1),
+               G("b0", queue="qb", tasks=2, gpu=1)],
+        # 4 requested, department allowance 2: exactly one job lands
+        # whole (gang all-or-nothing keeps 2-task jobs atomic)
+        expect_evictions=0,
+    ),
+    Case(
+        name="train_allocates_over_quota",
+        ref='allocate_test.go: "allocate pending jobs, allow over quota '
+            'for train jobs (with interactive jobs)"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("train0", tasks=2, gpu=1, priority=50)],
+        # preemptible train exceeds its 1-GPU deserved (no limit set)
+        expect={"train0": True},
+    ),
+    Case(
+        name="build_never_over_quota",
+        ref='allocate_test.go: "don\'t allocate over quota build jobs"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("build0", tasks=2, gpu=1, priority=100,
+                 preemptible=False)],
+        expect={"build0": 0},
+    ),
+    Case(
+        name="creation_time_breaks_equal_share",
+        ref='allocate_test.go: "allocate according to creation time '
+            'when share is equal"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("qa", quota=1), Q("qb", quota=1)],
+        gangs=[G("older", queue="qa", tasks=1, gpu=1),
+               G("newer", queue="qb", tasks=1, gpu=1)],
+        expect={"older": True, "newer": 0},
+    ),
+    Case(
+        name="priority_beats_creation",
+        ref='allocate_test.go: "allocate according to priority"',
+        nodes=[N("n0", gpu=1)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("older-low", tasks=1, gpu=1, priority=50),
+               G("newer-high", tasks=1, gpu=1, priority=100)],
+        expect={"newer-high": True, "older-low": 0},
+    ),
+    Case(
+        name="lower_share_queue_served_first",
+        ref='allocate_test.go: "1 build job pending for each queue '
+            'with different share - allocate the second"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("qa", quota=2), Q("qb", quota=2)],
+        gangs=[G("a-run", queue="qa", tasks=3, gpu=1, on=["n0"]),
+               G("a0", queue="qa", tasks=1, gpu=1, priority=100,
+                 preemptible=False),
+               G("b0", queue="qb", tasks=1, gpu=1, priority=100,
+                 preemptible=False)],
+        # qa sits at 3/2 share: only qb's build may take the last GPU
+        # (allocate-only, as the reference suite configures — the full
+        # pipeline would ALSO preempt a-run for a0 afterwards)
+        expect={"b0": True, "a0": 0},
+        actions=("allocate",),
+    ),
+    Case(
+        name="share_updates_during_allocation_round",
+        ref='allocate_test.go: "6 pending train jobs - allocate the 1st '
+            '2 of each queue (verify the share is being updated during '
+            'the allocation)"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("qa", quota=2), Q("qb", quota=2)],
+        gangs=[G(f"a{i}", queue="qa", tasks=1, gpu=1) for i in range(3)]
+        + [G(f"b{i}", queue="qb", tasks=1, gpu=1) for i in range(3)],
+        # live share interleaves the queues: two each, never 3+1
+        expect={"a0": True, "a1": True, "b0": True, "b1": True,
+                "a2": 0, "b2": 0},
+    ),
+    Case(
+        name="overprovision_round_robins_queues",
+        ref='allocate_test.go: "Over provisioning with over quota, many '
+            'queues to few GPUs - verify queue share is updated during '
+            'the same allocation round"',
+        nodes=[N("n0", gpu=3)],
+        queues=[Q(f"q{i}", quota=2) for i in range(3)],
+        gangs=[G(f"j{i}-{k}", queue=f"q{i}", tasks=1, gpu=1)
+               for i in range(3) for k in range(2)],
+        # 6 jobs over 3 queues, 3 GPUs: one job per queue
+        expect={"j0-0": True, "j1-0": True, "j2-0": True,
+                "j0-1": 0, "j1-1": 0, "j2-1": 0},
+    ),
+    Case(
+        name="departments_smaller_ratio_first",
+        ref='allocate_test.go: "Allocate Departments with smaller '
+            'ratio 1st"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("d0", quota=2), Q("d1", quota=2),
+                Q("qa", parent="d0", quota=2),
+                Q("qb", parent="d1", quota=2)],
+        gangs=[G("a-run", queue="qa", tasks=2, gpu=1, on=["n0"]),
+               G("a0", queue="qa", tasks=1, gpu=1),
+               G("b0", queue="qb", tasks=1, gpu=1)],
+        # d0 is at 2/2, d1 at 0/2: d1's job goes first; d0's train may
+        # then take the last GPU over quota
+        expect={"b0": True},
+    ),
+    Case(
+        name="interactive_capped_at_department_deserved",
+        ref='allocate_test.go: "Don\'t allow allocation of interactive '
+            'jobs above the department\'s deserved GPUs"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("d0", quota=1), Q("qa", parent="d0", quota=4)],
+        gangs=[G("i0", queue="qa", tasks=2, gpu=1, priority=100,
+                 preemptible=False)],
+        # the queue's own quota (4) would admit it, the department's
+        # deserved (1) does not — non-preemptible stays within ancestry
+        expect={"i0": 0},
+    ),
+    Case(
+        name="interactive_preempts_overquota_train",
+        ref='allocate_test.go: "try to allocate interactive after train '
+            'when over-quota - train should be preempted for '
+            'interactive to run"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=1)],
+        gangs=[G("train0", tasks=1, gpu=1, on=["n0"], priority=50),
+               G("train1", tasks=1, gpu=1, on=["n0"], priority=50),
+               G("int0", tasks=1, gpu=1, priority=100,
+                 preemptible=False)],
+        # queue holds 2 > 1 deserved; the interactive job is entitled
+        # to quota capacity: one train is preempted
+        expect={"int0": True},
+        expect_evictions=1,
+    ),
+    Case(
+        name="train_after_interactive_stays_pending",
+        ref='allocate_test.go: "try to allocate train after interactive '
+            'when over-quota - train should not run"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("qa", quota=1), Q("qb", quota=1)],
+        gangs=[G("int0", queue="qa", tasks=1, gpu=1, on=["n0"],
+                 priority=100, preemptible=False),
+               G("b-run", queue="qb", tasks=1, gpu=1, on=["n0"]),
+               G("train0", queue="qa", tasks=1, gpu=1, priority=50)],
+        # cluster full, qb at fair share: the over-share train has
+        # nothing to reclaim and nothing to preempt
+        expect={"train0": 0},
+        expect_evictions=0,
+    ),
+    Case(
+        name="cpu_queue_deserved_gate",
+        ref='allocate_test.go: "don\'t allow job over QUEUE deserved '
+            'CPU"',
+        nodes=[N("n0", gpu=0, cpu=16)],
+        queues=[Q("q0", cpu_quota=4)],
+        gangs=[G("c0", tasks=1, gpu=0, cpu=8, priority=100,
+                 preemptible=False)],
+        expect={"c0": 0},
+    ),
+    Case(
+        name="cpu_department_deserved_gate",
+        ref='allocate_test.go: "don\'t allow job over DEPARTMENT '
+            'deserved CPU"',
+        nodes=[N("n0", gpu=0, cpu=16)],
+        queues=[Q("d0", cpu_quota=4), Q("q0", parent="d0", cpu_quota=16)],
+        gangs=[G("c0", queue="q0", tasks=1, gpu=0, cpu=8, priority=100,
+                 preemptible=False)],
+        expect={"c0": 0},
+    ),
+    Case(
+        name="project_allowance_caps_queue",
+        ref='allocate_test.go: "allocate job but does not allow to '
+            'project to go over allowance"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("q0", quota=1, limit=2)],
+        gangs=[G("t0", tasks=2, gpu=1), G("t1", tasks=2, gpu=1)],
+        # maxAllowed 2 caps the queue even with idle capacity: one
+        # 2-GPU job lands, the other stays whole and pending
+        expect_evictions=0,
+        actions=("allocate",),
+    ),
+    Case(
+        name="interactive_within_quota_alongside_train",
+        ref='allocate_test.go: "allocate pending jobs, allow over '
+            'quota for train jobs (with interactive jobs)" — the '
+            'interactive side',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("i0", tasks=2, gpu=1, priority=100,
+                 preemptible=False),
+               G("t0", tasks=2, gpu=1, priority=50)],
+        # the build lands within deserved; the train then takes the
+        # rest over quota
+        expect={"i0": True, "t0": True},
+        actions=("allocate",),
+    ),
+    # ---- hierarchy depths (allocate_test.go hierarchy cases) ------------
+    Case(
+        name="hierarchy_single_level",
+        ref='allocate_test.go: "single level queue hierarchy - '
+            'allocate job"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("j0", tasks=2, gpu=1)],
+        expect={"j0": True},
+    ),
+    Case(
+        name="hierarchy_three_levels",
+        ref='allocate_test.go: "three level queue hierarchy - allocate '
+            'jobs across teams"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("org", quota=4),
+                Q("team-a", parent="org", quota=2),
+                Q("team-b", parent="org", quota=2),
+                Q("qa", parent="team-a", quota=2),
+                Q("qb", parent="team-b", quota=2)],
+        gangs=[G("a0", queue="qa", tasks=2, gpu=1),
+               G("b0", queue="qb", tasks=2, gpu=1)],
+        expect={"a0": True, "b0": True},
+    ),
+    Case(
+        name="hierarchy_four_levels_deepest_leaf",
+        ref='allocate_test.go: "four level queue hierarchy - allocate '
+            'job at deepest level"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("org", quota=2),
+                Q("div", parent="org", quota=2),
+                Q("team", parent="div", quota=2),
+                Q("leaf", parent="team", quota=2)],
+        gangs=[G("j0", queue="leaf", tasks=2, gpu=1)],
+        expect={"j0": True},
+    ),
+    # ---- fractional / MIG reclaim (reclaim suite) -----------------------
+    Case(
+        name="reclaim_fractional_by_whole_gpu",
+        ref='reclaim: "reclaim fractional train by whole GPU job"',
+        nodes=[N("n0", gpu=2, gpu_mem_gib=100)],
+        queues=[Q("qa", quota=1), Q("qb", quota=1)],
+        gangs=[G("a-f0", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("a-f1", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("a-f2", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[1]),
+               G("a-f3", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[1]),
+               G("b0", queue="qb", tasks=1, gpu=1)],
+        # qa holds both devices (2.0 > 1 deserved); a whole-GPU
+        # reclaimer needs one device fully vacated: both sharers of one
+        # device are evicted
+        expect={"b0": True},
+        expect_evictions=2,
+        expect_pipelined={"b0": 1},
+    ),
+    Case(
+        name="reclaim_fractional_partial",
+        ref='reclaim: "reclaim fractional train by fractional train GPU '
+            'job - reclaim only part of fractional jobs"',
+        nodes=[N("n0", gpu=1, gpu_mem_gib=100)],
+        queues=[Q("qa", quota=0.5), Q("qb", quota=0.5)],
+        gangs=[G("a-f0", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("a-f1", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("b0", queue="qb", tasks=1, gpu=0, portion=0.5)],
+        # qa holds 1.0 > 0.5 deserved: ONE fraction suffices for the
+        # 0.5 reclaimer
+        expect={"b0": True},
+        expect_evictions=1,
+    ),
+    Case(
+        name="reclaim_fractional_over_quota_blocked",
+        ref='reclaim: "reclaim fractional train by fractional GPU job '
+            'will go over quota - don\'t reclaim"',
+        nodes=[N("n0", gpu=2, gpu_mem_gib=100)],
+        queues=[Q("qa", quota=0.5), Q("qb", quota=0.5),
+                Q("qc", quota=0.5)],
+        gangs=[G("a-f0", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("a-f1", queue="qa", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[0]),
+               G("b-run", queue="qb", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[1]),
+               G("c-f0", queue="qc", tasks=1, gpu=0, portion=0.5,
+                 on=["n0"], devices=[1]),
+               G("b0", queue="qb", tasks=1, gpu=0, portion=0.5)],
+        # the cluster is full and qb already sits at its 0.5 share:
+        # reclaiming for b0 would take qb over quota — refused, even
+        # though qa is over share
+        expect={"b0": 0},
+        expect_evictions=0,
+    ),
+    Case(
+        name="reclaim_mig_simple",
+        ref='reclaim: "Simple reclaim with MIG jobs".  DIVERGENCE '
+            'NOTE: the reference counts a MIG profile\'s g-number '
+            'toward queue GPU quota (resource_info.go '
+            'GetTotalGPURequest); here queue fairness runs on core '
+            'resources, so the jobs pair each instance with a whole '
+            'GPU — the reclaim still frees and re-binds the MIG '
+            'instance (extended credit-back)',
+        nodes=[N("n0", gpu=2, mig={"nvidia.com/mig-1g.10gb": 2})],
+        queues=[Q("qa", quota=1), Q("qb", quota=1)],
+        gangs=[G("a0", queue="qa", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
+               G("a1", queue="qa", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
+               G("b0", queue="qb", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1})],
+        # both instances (and both GPUs) held by over-share qa; qb
+        # reclaims one job — its GPU and its MIG instance free together
+        expect={"b0": True},
+        expect_evictions=1,
+    ),
+    Case(
+        name="reclaim_mig_within_fair_share_safe",
+        ref='reclaim: "Should not reclaim jobs if job is within fair '
+            'share" (hybrid-pod shape, see reclaim_mig_simple note)',
+        nodes=[N("n0", gpu=2, mig={"nvidia.com/mig-1g.10gb": 2})],
+        queues=[Q("qa", quota=1), Q("qb", quota=1)],
+        gangs=[G("a0", queue="qa", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
+               G("b-run", queue="qb", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
+               G("b0", queue="qb", tasks=1, gpu=1,
+                 mig={"nvidia.com/mig-1g.10gb": 1})],
+        # one instance each: qa is within fair share, no eviction
+        expect={"b0": 0},
+        expect_evictions=0,
+    ),
+    # ---- whole-gang preemption (preemptGang_test.go) --------------------
+    Case(
+        name="gang_classic_whole_victim",
+        ref='preemptGang_test.go: "Classic gang preempt"',
+        nodes=[N("n0", gpu=2)],
+        queues=[Q("q0", quota=2)],
+        gangs=[G("victim", tasks=2, gpu=1, on=["n0"], priority=50),
+               G("pree", tasks=2, gpu=1, priority=100,
+                 preemptible=False)],
+        # the whole 2-task victim gang goes (gang-atomic victimhood)
+        expect={"pree": True},
+        expect_evictions=2,
+    ),
+    Case(
+        name="gang_preempt_only_what_is_needed",
+        ref='preemptGang_test.go: "Some of the pods are running and '
+            'some are pending- preempt those who are needed in order '
+            'to allocate all the pods of gang job"',
+        nodes=[N("n0", gpu=4)],
+        queues=[Q("q0", quota=4)],
+        gangs=[G("small", tasks=1, gpu=1, on=["n0"], priority=50),
+               G("small2", tasks=1, gpu=1, on=["n0"], priority=50),
+               G("pree", tasks=3, gpu=1, priority=100,
+                 preemptible=False)],
+        # 2 free + 1 from ONE evicted single-task victim suffices: the
+        # second low-priority job survives
+        expect={"pree": True},
+        expect_evictions=1,
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_hierarchy_order_scenario(case):
+    run_case(case)
