@@ -49,6 +49,12 @@ def _build_config(args) -> "SchedulerConfig":
     if args.node_pool_label_key:
         cfg = dataclasses.replace(
             cfg, node_pool_label_key=args.node_pool_label_key)
+    if args.pyroscope_address is not None:
+        cfg = dataclasses.replace(
+            cfg, pyroscope_address=args.pyroscope_address)
+    if args.profiler_sample_hz is not None:
+        cfg = dataclasses.replace(
+            cfg, profiler_sample_hz=args.profiler_sample_hz)
     return cfg
 
 
@@ -67,6 +73,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="per-action queue depth override")
     parser.add_argument("--snapshot", help="cluster snapshot JSON(.gz)")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--pyroscope-address", default=None,
+                        help="continuous-profile push URL (ref "
+                             "pyroscope-address, options.go:110)")
+    parser.add_argument("--profiler-sample-hz", type=float, default=None,
+                        help="continuous profiler wall-stack sample "
+                             "rate; 0 disables")
     args = parser.parse_args(argv)
 
     from . import conf

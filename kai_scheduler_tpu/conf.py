@@ -146,6 +146,13 @@ def load_config(doc: dict | str | None,
     if "schedulePeriod" in doc:
         out = dataclasses.replace(
             out, schedule_period_s=float(doc["schedulePeriod"]))
+    if "pyroscopeAddress" in doc:
+        out = dataclasses.replace(
+            out, pyroscope_address=str(doc["pyroscopeAddress"] or ""))
+    if "profilerSampleHz" in doc:
+        hz = doc["profilerSampleHz"]
+        out = dataclasses.replace(
+            out, profiler_sample_hz=None if hz is None else float(hz))
     return out
 
 
@@ -171,6 +178,11 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "tiers": list(placement.tiers),
         },
         "staleGangGracePeriodSeconds": cfg.session.stale_grace_s,
+        "pyroscopeAddress": cfg.pyroscope_address,
+        # None (unset) round-trips as null: an address alone means
+        # 100 Hz, while an explicit 0 disables — collapsing unset to
+        # 0.0 would silently turn the sampler off on reload
+        "profilerSampleHz": cfg.profiler_sample_hz,
     }
 
 

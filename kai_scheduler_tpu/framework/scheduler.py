@@ -199,6 +199,15 @@ class SchedulerConfig:
     leader_lease: object | None = None
     #: this instance's election identity (pod name in the reference)
     identity: str = "scheduler-0"
+    #: continuous-profiling push target (ref ``pyroscope-address``
+    #: flag, ``cmd/scheduler/app/options/options.go:110-113``); "" with
+    #: profiler_sample_hz=0 leaves the sampler off, "" with a rate
+    #: retains windows locally for ``/debug/pprof/continuous``
+    pyroscope_address: str = ""
+    #: wall-stack samples per second (the mutex/block-rate analogue for
+    #: a Python runtime); None = unset (an address alone implies
+    #: 100 Hz), an explicit 0 disables even with an address
+    profiler_sample_hz: float | None = None
 
 
 def apply_shard_args(session: SessionConfig,

@@ -188,6 +188,23 @@ class SchedulerServer:
                  port: int = 0):
         self.cluster = cluster
         self.scheduler = scheduler or Scheduler()
+        # continuous profiling (the Pyroscope analogue): started when
+        # the scheduler config names a push address or a sample rate;
+        # retained windows are always scrapeable once running
+        # continuous profiling (the Pyroscope analogue) — created here,
+        # STARTED in start() so a never-started server leaks no sampler
+        self.profiler = None
+        cfg = self.scheduler.config
+        hz = getattr(cfg, "profiler_sample_hz", None)
+        addr = getattr(cfg, "pyroscope_address", "")
+        # an address with an UNSET rate defaults to 100 Hz; an explicit
+        # rate of 0 keeps the sampler off even with an address
+        if (hz or 0) > 0 or (addr and hz is None):
+            from ..runtime.profiling import ContinuousProfiler
+            self.profiler = ContinuousProfiler(
+                sample_hz=hz if hz else 100.0,
+                server_address=addr,
+            )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -204,6 +221,18 @@ class SchedulerServer:
                     self._send(job_order(outer.cluster, outer.scheduler))
                 elif self.path == "/snapshot":
                     self._send(dump_cluster(outer.cluster))
+                elif self.path.startswith("/debug/pprof/continuous"):
+                    # the continuous-profiling (Pyroscope) analogue:
+                    # retained folded-stack windows
+                    if outer.profiler is None:
+                        self.send_error(404, "continuous profiler off")
+                        return
+                    body = outer.profiler.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path.startswith("/debug/pprof"):
                     # the --enable-profiler pprof endpoint analogue
                     self._send(profile_cycle(outer.cluster,
@@ -298,9 +327,13 @@ class SchedulerServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        if self.profiler is not None:
+            self.profiler.start()
         return self
 
     def stop(self) -> None:
+        if self.profiler is not None:
+            self.profiler.stop()
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -156,6 +156,12 @@ class Session:
                 victims=dataclasses.replace(
                     config.victims,
                     chunk_reclaim=not index.has_reclaim_minruntime,
+                    # preemptors spread over many queues want chunks at
+                    # least that wide (see VictimConfig.batch_size_preempt)
+                    batch_size_preempt=(
+                        256 if index.num_leaf_queues > 64
+                        and config.victims.batch_size_preempt is None
+                        else config.victims.batch_size_preempt),
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..apis.types import UNLIMITED
+from ..utils.numerics import cumsum_ds
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
@@ -91,12 +92,19 @@ class VictimConfig:
     #: preemptor gangs attempted per wavefront chunk (reclaim/preempt).
     #: Each pod of the frozen eviction-unit order is consumed by the
     #: FIRST lane whose budget covers it and whose queue may evict it
-    #: (exact own-queue exclusion), so victim assignment cannot
-    #: conflict; an allocate-style accept-prefix re-verifies composed
-    #: capacity and queue gates.  1 = fully sequential (reference-exact
-    #: order).  64 measured fastest at the 10k-node × 50k-pod baseline
-    #: (4 chunks for 256 preemptors).
+    #: (reclaim: other-queue flow; preempt: queue-segmented per-lane
+    #: watermarks), so victim assignment cannot conflict; an
+    #: allocate-style accept-prefix re-verifies composed capacity and
+    #: queue gates.  1 = fully sequential (reference-exact order).
+    #: 64 measured fastest at the 10k-node × 50k-pod baseline.
     batch_size: int = 64
+    #: preempt's own chunk width; None = inherit ``batch_size``.
+    #: Preempt chunks pack lanes across queues (queue-segmented budget
+    #: math), so a many-queue snapshot wants chunks at least as wide as
+    #: its preemptor spread (512 queues × 1 preemptor measured 214 ms
+    #: at 64 lanes vs 136 ms at 256) — the Session auto-tunes this from
+    #: the snapshot's leaf-queue count.
+    batch_size_preempt: int | None = None
     #: reclaim may use the chunked path — False when the snapshot
     #: carries per-(victim,reclaimer) reclaim-minruntime protection,
     #: whose lane-dependent tables need the sequential path.  The
@@ -433,7 +441,7 @@ def solve_for_preemptor(
     # ---- per-unit tables, vectorized over ALL unit ranks at once --------
     unit_req = jax.ops.segment_sum(
         m_req, urank_safe, num_segments=M + 1)[:M]             # [U, R]
-    cum_freed = jnp.cumsum(unit_req, axis=0)                   # [U, R]
+    cum_freed = cumsum_ds(unit_req, axis=0)                    # [U, R]
     # idle_gpus-style prefilter: the first scenario whose aggregate
     # free + freed covers the preemptor's request lower-bounds the search
     cluster_free = jnp.sum(
@@ -459,7 +467,7 @@ def solve_for_preemptor(
                 leaf_safe)                                     # [U]
         contrib = chain[leaf_safe] & (unit_leaf >= 0)[:, None]  # [U, Q]
         inc = contrib[:, :, None] * unit_req[:, None, :]       # [U, Q, R]
-        csum_excl = jnp.cumsum(inc, axis=0) - inc
+        csum_excl = cumsum_ds(inc, axis=0) - inc
         lq_safe = jnp.maximum(lq_u, 0)
         freed_excl = csum_excl[jnp.arange(M), lq_safe]         # [U, R]
         remaining_u = qa[lq_safe] - freed_excl
@@ -819,7 +827,10 @@ def _run_victim_action_chunked(
     g, q, n, r = state.gangs, state.queues, state.nodes, state.running
     G, T, M, Q = g.g, g.t, r.m, q.q
     R_ = n.free.shape[1]
-    B = max(1, min(config.batch_size, G))
+    bs = (config.batch_size_preempt
+          if mode == "preempt" and config.batch_size_preempt is not None
+          else config.batch_size)
+    B = max(1, min(bs, G))
     total = state.total_capacity
     pcfg = config.placement
     depth = (config.queue_depth_preempt
@@ -853,7 +864,7 @@ def _run_victim_action_chunked(
     unit_req = jax.ops.segment_sum(
         jnp.where(cand0[:, None], r.req, 0.0), urank_safe,
         num_segments=M + 1)[:M]                                  # [U, R]
-    C_all = jnp.cumsum(unit_req, axis=0)                         # inclusive
+    C_all = cumsum_ds(unit_req, axis=0)                          # inclusive
     unit_leaf = jax.ops.segment_max(
         jnp.where(cand0, r.queue, -1), urank_safe,
         num_segments=M + 1)[:M]                                  # [U]
@@ -861,7 +872,7 @@ def _run_victim_action_chunked(
     has_leaf = unit_leaf >= 0
     onehot_leaf = ((unit_leaf[:, None] == jnp.arange(Q)[None, :])
                    & has_leaf[:, None])                          # [U, Q]
-    C_leaf = jnp.cumsum(
+    C_leaf = cumsum_ds(
         onehot_leaf[:, :, None] * unit_req[:, None, :], axis=0)  # [U, Q, R]
     cnt_leaf = jnp.cumsum(onehot_leaf.astype(jnp.int32), axis=0)
     cl = jnp.concatenate(
@@ -874,7 +885,7 @@ def _run_victim_action_chunked(
         # EXCLUSIVE-before-u subtree-cumulative freed (strategy bounds)
         inc_sub = ((chain[leaf_safe] & has_leaf[:, None])[:, :, None]
                    * unit_req[:, None, :])                       # [U, Q, R]
-        S_cols = (jnp.cumsum(inc_sub, axis=0) - inc_sub).reshape(M, Q * R_)
+        S_cols = (cumsum_ds(inc_sub, axis=0) - inc_sub).reshape(M, Q * R_)
         prio_by_q = None
     else:
         unit_prio = jax.ops.segment_max(
@@ -904,23 +915,21 @@ def _run_victim_action_chunked(
         ext_extra = res.extended_releasing_extra
 
         # ---- lanes: first B remaining gangs in frozen order -------------
+        # (any queue mix: preempt's own-queue-local budgets/consumption
+        # are kept exact by QUEUE-SEGMENTED cumulative pricing, unit
+        # ranks, watermarks and pointers below — a 256-preemptor burst
+        # in one queue packs B lanes per chunk like the single-queue
+        # code always did, AND 512 queues × 1 preemptor each share
+        # chunks instead of degrading to one queue per chunk)
         flags = remaining[order0]                                # [G]
-        if not reclaim:
-            # preempt budgets/consumption are own-queue-LOCAL: mixing
-            # queues in one chunk would price every lane's cumulative
-            # request against its own queue's victims alone (mass
-            # over-eviction), let the running-max budget leak units
-            # above a later lane's priority bound, and misalign the
-            # >=1-new-unit rank count — so a preempt chunk draws all
-            # its lanes from the head gang's queue
-            q0 = qi_ord[jnp.argmax(flags)]
-            flags = flags & (qi_ord == q0)
         rnk = jnp.cumsum(flags.astype(jnp.int32)) - 1
         pos = jnp.where(flags & (rnk < B), rnk, B)
         cand_g = jnp.full((B + 1,), G, jnp.int32).at[pos].set(order0)[:B]
         cand_valid = jnp.zeros((B + 1,), bool).at[pos].set(True)[:B]
         gsafe_b = jnp.minimum(cand_g, G - 1)
         q_b = gq[gsafe_b]                                        # [B]
+        # lanes of the same queue (preempt's segmented per-queue math)
+        same_q_b = (q_b[None, :] == q_b[:, None])                # [B, B]
 
         # ---- lane budgets over the frozen unit order --------------------
         lane_req = jnp.where(cand_valid[:, None],
@@ -929,7 +938,21 @@ def _run_victim_action_chunked(
         cluster_free = jnp.sum(
             jnp.where(n.valid[:, None], free + n.releasing + extra, 0.0),
             axis=0)
-        targets = cum_req - cluster_free[None, :] - EPS          # [B, R]
+        if reclaim:
+            targets = cum_req - cluster_free[None, :] - EPS      # [B, R]
+        else:
+            # QUEUE-SEGMENTED cumulative pricing: a lane's target is the
+            # cumulative request of its OWN queue's lanes so far (its
+            # victims can only come from there), optimistically assuming
+            # the whole idle pool (queues double-counting free
+            # under-evict, which the accept prefix rejects and the lane
+            # retries next chunk — over-eviction never happens).  For a
+            # single-queue chunk this is exactly the full cumulative.
+            seg_incl = (same_q_b & (lanes[None, :] <= lanes[:, None])
+                        & cand_valid[None, :])                   # [B, B]
+            cum_req_q = jnp.einsum(
+                "bc,cr->br", seg_incl.astype(lane_req.dtype), lane_req)
+            targets = cum_req_q - cluster_free[None, :] - EPS
         need_b = cand_valid & jnp.any(targets > 0, axis=-1)
         csafe = jnp.clip(c, 0, M - 1)
         Cv_at_c = jnp.where((c >= 0)[:, None],
@@ -956,9 +979,14 @@ def _run_victim_action_chunked(
         cum_av = jnp.cumsum(avail_u.astype(jnp.int32))           # [U]
         if reclaim:
             cum_av_b = cum_av[None, :] - cum_av_leaf[:, q_b].T   # [B, U]
+            vrank = jnp.cumsum(cand_valid.astype(jnp.int32)) - 1  # [B]
         else:
             cum_av_b = cum_av_leaf[:, q_b].T
-        vrank = jnp.cumsum(cand_valid.astype(jnp.int32)) - 1     # [B]
+            # ordinal among the lane's OWN queue's valid lanes: the
+            # (k+1)-th same-queue lane needs k+1 available own units
+            vrank = jnp.sum(
+                same_q_b & (lanes[None, :] < lanes[:, None])
+                & cand_valid[None, :], axis=1).astype(jnp.int32)
         K_min = jax.vmap(jnp.searchsorted)(
             cum_av_b, vrank + 1).astype(jnp.int32)               # [B]
         K_raw = jnp.where(cand_valid, jnp.maximum(K_cap, K_min), -1)
@@ -1019,24 +1047,39 @@ def _run_victim_action_chunked(
         gate_b &= cand_valid & (K_raw <= hi_b) & ~insufficient_b
 
         # ---- pod → lane assignment + per-lane freed pools ---------------
-        # first lane whose budget covers the pod AND whose queue may
-        # evict it: a unit skipped by its own queue's lane flows to the
-        # next other-queue lane (reclaim) / next same-queue lane
-        # (preempt) instead of being lost
-        if reclaim:
-            may = q_b[None, :] != jnp.arange(Q)[:, None]         # [Q, B]
-        else:
-            may = q_b[None, :] == jnp.arange(Q)[:, None]
-        may = may & cand_valid[None, :]
-        nxt = jnp.where(may, lanes[None, :], B)                  # [Q, B]
-        next_ok = jnp.flip(jax.lax.associative_scan(
-            jnp.minimum, jnp.flip(nxt, axis=1), axis=1), axis=1)  # [Q, B]
-        next_ok = jnp.concatenate(
-            [next_ok, jnp.full((Q, 1), B, jnp.int32)], axis=1)   # [Q, B+1]
         live0 = cand0 & (unit_rank > c[pod_leaf])
-        lane0 = jnp.searchsorted(K_b, unit_rank)                 # [M] 0..B
-        lane_of_pod = jnp.where(
-            live0, next_ok[pod_leaf, jnp.minimum(lane0, B)], B)
+        if reclaim:
+            # first lane whose budget covers the pod AND whose queue may
+            # evict it: a unit skipped by its own queue's lane flows to
+            # the next other-queue lane instead of being lost
+            may = q_b[None, :] != jnp.arange(Q)[:, None]         # [Q, B]
+            may = may & cand_valid[None, :]
+            nxt = jnp.where(may, lanes[None, :], B)              # [Q, B]
+            next_ok = jnp.flip(jax.lax.associative_scan(
+                jnp.minimum, jnp.flip(nxt, axis=1), axis=1),
+                axis=1)                                          # [Q, B]
+            next_ok = jnp.concatenate(
+                [next_ok, jnp.full((Q, 1), B, jnp.int32)],
+                axis=1)                                          # [Q, B+1]
+            lane0 = jnp.searchsorted(K_b, unit_rank)             # [M] 0..B
+            lane_of_pod = jnp.where(
+                live0, next_ok[pod_leaf, jnp.minimum(lane0, B)], B)
+        else:
+            # PER-QUEUE running-max watermark: a unit flows to the first
+            # same-queue lane whose watermark covers its rank (exactly
+            # the old single-queue assignment, segmented per queue — no
+            # cross-queue leak).  [M, B] compare-and-min; B is small.
+            K_wm = jnp.max(jnp.where(
+                same_q_b & (lanes[None, :] <= lanes[:, None])
+                & cand_valid[None, :], K_raw[None, :], -1),
+                axis=1)                                          # [B]
+            cand_lane = ((pod_leaf[:, None] == q_b[None, :])
+                         & cand_valid[None, :]
+                         & (K_wm[None, :] >= urank_safe[:, None]))
+            lane_of_pod = jnp.where(
+                live0,
+                jnp.min(jnp.where(cand_lane, lanes[None, :], B),
+                        axis=1), B)
         (freed_n_b, freed_d_b, freed_q_b, freed_e_b,
          own_incr_b) = _freed_by_lane(state, lane_of_pod, B, chain)
         extra_b = extra[None] + freed_n_b                        # [B, N, R]
@@ -1153,8 +1196,16 @@ def _run_victim_action_chunked(
         victims = (lane_of_pod <= star) & any_take
         # per-queue consumed pointers: the max committed budget among
         # accepted lanes allowed to evict from that queue
-        M_v = jnp.max(jnp.where(take[None, :] & may,
-                                K_b[None, :], -1), axis=1)       # [Q]
+        if reclaim:
+            M_v = jnp.max(jnp.where(take[None, :] & may,
+                                    K_b[None, :], -1), axis=1)   # [Q]
+        else:
+            # accepted lanes advance their OWN queue's pointer to their
+            # per-queue watermark
+            M_v = jax.ops.segment_max(
+                jnp.where(take & cand_valid, K_wm, -1),
+                jnp.where(cand_valid, q_b, Q),
+                num_segments=Q + 1)[:Q]
         c2 = jnp.maximum(c, M_v)
 
         w = take.astype(free.dtype)

@@ -1,0 +1,136 @@
+"""Continuous profiling — the Pyroscope analogue.
+
+The reference streams Go runtime profiles to a Pyroscope server for the
+life of the process (``cmd/scheduler/profiling/pyroscope.go:13-30``,
+flags ``cmd/scheduler/app/options/options.go:110-113``).  The Python
+equivalent here is a wall-clock stack sampler: a daemon thread samples
+every live thread's stack ``sample_hz`` times per second, folds them
+into Brendan-Gregg collapsed-stack lines ("a;b;c count"), rolls the
+aggregate over fixed windows, and either
+
+- POSTs each closed window to a configured server (the
+  ``pyroscope-address`` flag; Pyroscope's HTTP ``/ingest`` API accepts
+  exactly this folded-text format), and/or
+- retains a ring of recent windows served by the PluginServer at
+  ``GET /debug/pprof/continuous`` — so a cluster without a Pyroscope
+  deployment still gets scrapeable continuous profiles.
+
+Push failures are swallowed after counting (a profiling sink must never
+affect scheduling).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import urllib.request
+
+__all__ = ["ContinuousProfiler"]
+
+
+class ContinuousProfiler:
+    """Folded-stack wall sampler with windowed push/retain."""
+
+    def __init__(self, *, sample_hz: float = 100.0, window_s: float = 10.0,
+                 server_address: str = "", app_name: str = "kai-scheduler",
+                 retain_windows: int = 6):
+        self.sample_hz = max(1.0, float(sample_hz))
+        self.window_s = max(0.1, float(window_s))
+        self.server_address = server_address.rstrip("/")
+        self.app_name = app_name
+        self.retain_windows = retain_windows
+        self._lock = threading.Lock()
+        self._current: dict[str, int] = {}
+        self._window_start = time.time()
+        #: closed windows, newest last: (start_ts, end_ts, folded dict)
+        self.windows: list[tuple[float, float, dict[str, int]]] = []
+        self.pushed = 0
+        self.push_errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- sampling ---------------------------------------------------------
+
+    def _fold(self, frame) -> str:
+        parts: list[str] = []
+        while frame is not None:
+            code = frame.f_code
+            parts.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]})")
+            frame = frame.f_back
+        return ";".join(reversed(parts))
+
+    def _sample_once(self) -> None:
+        me = threading.get_ident()
+        frames = sys._current_frames()
+        with self._lock:
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue  # the sampler observing itself is noise
+                key = self._fold(frame)
+                self._current[key] = self._current.get(key, 0) + 1
+
+    def _roll_window(self, now: float) -> None:
+        with self._lock:
+            window = (self._window_start, now, self._current)
+            self._current = {}
+            self._window_start = now
+            self.windows.append(window)
+            del self.windows[:-self.retain_windows]
+        if self.server_address and window[2]:
+            self._push(window)
+
+    def _push(self, window) -> None:
+        start, end, folded = window
+        body = self.render_folded(folded).encode()
+        url = (f"{self.server_address}/ingest?name={self.app_name}"
+               f"&from={int(start)}&until={int(end)}&format=folded")
+        try:
+            req = urllib.request.Request(url, data=body, method="POST")
+            urllib.request.urlopen(req, timeout=2.0).read()
+            self.pushed += 1
+        except Exception:  # noqa: BLE001 — profiling must never bite
+            self.push_errors += 1
+
+    def _run(self) -> None:
+        period = 1.0 / self.sample_hz
+        next_roll = self._window_start + self.window_s
+        while not self._stop.wait(period):
+            self._sample_once()
+            now = time.time()
+            if now >= next_roll:
+                self._roll_window(now)
+                next_roll = now + self.window_s
+
+    # -- lifecycle / rendering -------------------------------------------
+
+    def start(self) -> "ContinuousProfiler":
+        if self._thread is None:
+            self._window_start = time.time()
+            self._thread = threading.Thread(
+                target=self._run, name="continuous-profiler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self._roll_window(time.time())
+
+    @staticmethod
+    def render_folded(folded: dict[str, int]) -> str:
+        return "\n".join(f"{k} {v}" for k, v in sorted(folded.items()))
+
+    def render(self) -> str:
+        """All retained windows plus the in-flight one, newest last,
+        separated by window headers — the ``/debug/pprof/continuous``
+        body."""
+        with self._lock:
+            parts = []
+            for start, end, folded in self.windows:
+                parts.append(f"# window {start:.0f}-{end:.0f}")
+                parts.append(self.render_folded(folded))
+            parts.append(f"# window {self._window_start:.0f}-now")
+            parts.append(self.render_folded(self._current))
+        return "\n".join(p for p in parts if p)

@@ -373,6 +373,9 @@ class SnapshotIndex:
     #: deepest queue depth (0 = flat) — Session widens its division
     #: recursion to cover the whole hierarchy
     max_queue_depth: int = 1
+    #: valid childless queues — preempt chunk width auto-tunes with
+    #: this (preemptors spread across many queues fill wider chunks)
+    num_leaf_queues: int = 0
     #: emitted term-row count (the anti_used table's row dimension is
     #: sized from the state arrays; this is informational)
     num_anti_groups: int = 0
@@ -1589,6 +1592,9 @@ def build_snapshot(
         num_anti_groups=len(anti_term_level),
         has_attract_groups=bool((gk["attract_needs"] >= 0).any()),
         max_queue_depth=int(q_depth.max(initial=0)),
+        num_leaf_queues=int(
+            (q_valid & ~np.isin(np.arange(Q),
+                                q_parent[q_parent >= 0])).sum()),
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

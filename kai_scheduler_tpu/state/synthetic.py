@@ -33,6 +33,10 @@ def make_cluster(
     #: reclaimers (the reclaim benchmark shape)
     partition_queues_by_running: bool = False,
     priority_spread: int = 1,
+    #: added to every PENDING gang's priority — makes each pending gang
+    #: outrank the running gangs of its own queue (the many-queue
+    #: preempt shape)
+    pending_priority_boost: int = 0,
     topology_levels: tuple[int, ...] = (),
     required_level: str | None = None,
     seed: int = 0,
@@ -107,7 +111,8 @@ def make_cluster(
             name=f"gang-{g}",
             queue=queue,
             min_member=tasks_per_gang,
-            priority=int(rng.integers(0, priority_spread)),
+            priority=int(rng.integers(0, priority_spread))
+            + (0 if running else pending_priority_boost),
             creation_timestamp=float(g),
             last_start_timestamp=0.0 if running else None,
             topology_constraint=(

@@ -1,0 +1,45 @@
+"""Precision helpers for long f32 reductions on TPU.
+
+The reference's fairness/victim arithmetic runs in Go float64
+(``pkg/scheduler/plugins/proportion/resource_division/resource_division.go:26-41``).
+TPU kernels run f32; a plain f32 cumulative sum over the 50k-unit
+victim tables with GiB-scale values carries ~1e-7 relative error —
+measured ~1.4 GiB absolute at the tail, larger than a small pod's
+request, so a capacity comparison within that band of its bound could
+flip versus exact arithmetic (SURVEY §7 hard-part 5).
+
+``cumsum_ds`` keeps the scan in f32 but carries a double-single
+(compensated) error term through an associative two-sum, squaring the
+effective precision (~1e-14 relative) for 2× the flops of the plain
+scan — the TPU-native answer to "compute it in float64".
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _two_sum(a: jax.Array, b: jax.Array):
+    """Knuth two-sum: s + err == a + b exactly (all f32)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def cumsum_ds(x: jax.Array, axis: int = 0) -> jax.Array:
+    """Compensated (double-single) cumulative sum along ``axis``.
+
+    Associative, so it lowers to the same parallel-scan structure XLA
+    uses for ``jnp.cumsum``; each combine carries the rounding residue
+    of the partial sums instead of dropping it."""
+
+    def combine(ca, cb):
+        s_a, e_a = ca
+        s_b, e_b = cb
+        s, e = _two_sum(s_a, s_b)
+        return s, e + e_a + e_b
+
+    s, e = jax.lax.associative_scan(
+        combine, (x, jnp.zeros_like(x)), axis=axis)
+    return s + e

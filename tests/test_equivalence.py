@@ -128,3 +128,28 @@ def test_uniform_kernel_matches_per_task(seed, strategy):
     placed_u = (np.asarray(outs[True].placements) >= 0).sum(-1)
     placed_t = (np.asarray(outs[False].placements) >= 0).sum(-1)
     assert (placed_u == placed_t).all()
+
+
+@pytest.mark.parametrize("seed", [0, 2])
+def test_many_queue_preempt_chunk_matches_sequential(seed):
+    """One boosted preemptor in EACH of 16 queues (the many-queue
+    shape): the one-lane-per-queue chunk must admit exactly the
+    sequential scan's preemptors per queue without over-evicting."""
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=32, node_accel=2.0, num_gangs=48, tasks_per_gang=1,
+        running_fraction=32 / 48, num_departments=2,
+        queues_per_department=8, pending_priority_boost=100, seed=seed)
+    ses = Session.open(nodes, queues, groups, pods, topo)
+    outs = {}
+    for b in (1, 32):
+        cfg = dataclasses.replace(ses.config.victims, batch_size=b,
+                                  batch_size_preempt=b)
+        res = jax.block_until_ready(jax.jit(functools.partial(
+            run_victim_action, num_levels=2, mode="preempt", config=cfg))(
+                ses.state, ses.state.queues.fair_share,
+                init_result(ses.state)))
+        outs[b] = res
+    assert (np.asarray(outs[1].allocated)
+            == np.asarray(outs[32].allocated)).all()
+    assert (int(np.asarray(outs[32].victim).sum())
+            <= int(np.asarray(outs[1].victim).sum()))
