@@ -151,6 +151,7 @@ class Session:
                     config.allocate, track_devices=devices,
                     uniform_tasks=uniform, subgroup_topology=sub_topo,
                     extended=ext, dense_feasibility=dense,
+                    preferred_topology=index.has_preferred_topology,
                     anti_groups=index.has_anti_groups,
                     attract_groups=index.has_attract_groups),
                 victims=dataclasses.replace(
@@ -166,6 +167,7 @@ class Session:
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
                         extended=ext, dense_feasibility=dense,
+                        preferred_topology=index.has_preferred_topology,
                         anti_groups=index.has_anti_groups,
                         attract_groups=index.has_attract_groups)))
         fair_share = _set_fair_share_jit(

@@ -410,6 +410,11 @@ class AllocateConfig:
     #: in ``attract_static``; anchors mark through the shared
     #: ``anti_marks`` machinery).  Requires ``anti_groups``.
     attract_groups: bool = False
+    #: compile the PREFERRED-level locality band (anchor the gang near
+    #: its best node's preferred domain).  The Session derives this from
+    #: the snapshot — gangs without preferred levels skip the band's
+    #: per-lane argmax + domain compare over the node axis entirely.
+    preferred_topology: bool = True
     #: uniform-kernel wavefront protocol: lanes emit placements only and
     #: the chunk reconstructs capacity deltas with K-entry sparse
     #: scatters (False restores the dense [B, N, R] delta/cumsum accept
@@ -1023,10 +1028,13 @@ def _attempt_gang_in_domain_uniform(
         scores0 = score_nodes_for_task(
             n, free, req, fit_idle, fit_pipe, config.placement,
             extra=extra_bands_u)                        # [N]
-    best = jnp.argmax(scores0)
-    topo_band = jnp.where(
-        has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
-    scores = jnp.where(fit_pipe, scores0 + topo_band, scores0)
+    if config.preferred_topology:
+        best = jnp.argmax(scores0)
+        topo_band = jnp.where(
+            has_pref & (pref_doms == pref_doms[best]), W_TOPOLOGY, 0.0)
+        scores = jnp.where(fit_pipe, scores0 + topo_band, scores0)
+    else:
+        scores = scores0
 
     # ---- greedy fill by score order -------------------------------------
     # top_k instead of a full argsort: at most T replicas place and every

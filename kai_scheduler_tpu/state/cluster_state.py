@@ -357,6 +357,7 @@ class SnapshotIndex:
     uniform_gangs: bool = False
     has_required_topology: bool = True
     has_subgroup_topology: bool = True
+    has_preferred_topology: bool = True
     has_extended_resources: bool = False
     extended_keys: list[str] = dataclasses.field(default_factory=list)
     #: any queue configures reclaimMinRuntime — its per-(victim,
@@ -1583,6 +1584,7 @@ def build_snapshot(
         needs_device_table=has_fracs,
         uniform_gangs=uniform,
         has_required_topology=bool((gk["required_level"] >= 0).any()),
+        has_preferred_topology=bool((gk["preferred_level"] >= 0).any()),
         has_subgroup_topology=bool(
             (gk["subgroup_required_level"] >= 0).any()),
         has_extended_resources=bool(ext_keys),

@@ -9,6 +9,10 @@ from kai_scheduler_tpu.ops import drf
 from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
 from kai_scheduler_tpu.state import build_snapshot, make_cluster
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 
 def run_allocate(state, *, num_levels=2, **cfg):
     fs = drf.set_fair_share(state, num_levels=num_levels)

@@ -5,6 +5,10 @@ import numpy as np
 from kai_scheduler_tpu.apis import types as apis
 from kai_scheduler_tpu.state import build_snapshot, make_cluster
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 
 def test_build_snapshot_shapes_and_padding():
     nodes, queues, groups, pods, topo = make_cluster(

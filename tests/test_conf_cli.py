@@ -10,6 +10,10 @@ from kai_scheduler_tpu.runtime import snapshot
 from kai_scheduler_tpu.runtime.cluster import Cluster
 from kai_scheduler_tpu.state import make_cluster
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 DOC = """
 actions: "allocate, reclaim"
 tiers:

@@ -12,6 +12,8 @@ import pytest
 from kai_scheduler_tpu.apis.types import UNLIMITED
 from kai_scheduler_tpu.ops import drf
 
+pytestmark = pytest.mark.core
+
 
 def one_level(total, quota, weight, limit, request, priority=None, usage=None,
               creation=None, k=0.0):

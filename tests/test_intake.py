@@ -9,6 +9,8 @@ from kai_scheduler_tpu.podgrouper import (GrouperHub, PodGroupReconciler,
                                           Workload)
 from kai_scheduler_tpu.runtime.cluster import Cluster
 
+pytestmark = pytest.mark.core
+
 Vec = apis.ResourceVec
 
 

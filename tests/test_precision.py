@@ -21,6 +21,10 @@ from kai_scheduler_tpu.ops import drf
 from kai_scheduler_tpu.state import make_cluster
 from kai_scheduler_tpu.utils.numerics import cumsum_ds
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 
 def _to64(tree):
     return jax.tree.map(

@@ -7,6 +7,10 @@ from kai_scheduler_tpu.apis import types as apis
 from kai_scheduler_tpu.ops import predicates, scoring
 from kai_scheduler_tpu.state import build_snapshot, make_cluster
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 
 def small_state(**kw):
     nodes, queues, groups, pods, topo = make_cluster(**kw)

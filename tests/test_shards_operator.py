@@ -11,6 +11,10 @@ from kai_scheduler_tpu.framework.scheduler import Scheduler, SchedulerConfig
 from kai_scheduler_tpu.operator import Operator
 from kai_scheduler_tpu.runtime.cluster import Cluster
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 POOL = apis.NODE_POOL_LABEL_KEY
 
 

@@ -11,6 +11,10 @@ from kai_scheduler_tpu.ops.allocate import AllocateConfig, allocate
 from kai_scheduler_tpu.runtime.cluster import Cluster
 from kai_scheduler_tpu.state import build_snapshot
 
+import pytest
+
+pytestmark = pytest.mark.core
+
 
 def run_allocate(state, *, num_levels=1, **cfg):
     fs = drf.set_fair_share(state, num_levels=num_levels)

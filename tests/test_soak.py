@@ -25,6 +25,8 @@ from kai_scheduler_tpu.binder.binder import Binder
 from kai_scheduler_tpu.framework.scheduler import Scheduler
 from kai_scheduler_tpu.runtime.cluster import Cluster
 
+pytestmark = pytest.mark.slow
+
 
 def _check_invariants(cluster: Cluster, final: bool = False):
     # capacity + device booking per node
