@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import re
 
 import jax
 import jax.numpy as jnp
@@ -1465,11 +1466,46 @@ def build_snapshot(
     np.add.at(q_alloc, rk["queue"][vmask], rk["req"][vmask])
     np_mask = vmask & ~rk["preemptible"]
     np.add.at(q_alloc_np, rk["queue"][np_mask], rk["req"][np_mask])
+    # MIG profiles count their g-number toward queue GPU accounting
+    # (ref resource_info.go GetTotalGPURequest: totalGpusQuota +=
+    # gpuPortion * count).  The g-equivalents enter the SNAPSHOT
+    # rollups — allocated, request, and through them the fairness
+    # division — so over-share detection and the reclaim gates fire for
+    # pure-MIG queues.  In-cycle placement deltas remain core-resource;
+    # a cycle's own MIG placements show up in the next snapshot
+    # (bounded staleness, same convergence class as the other
+    # snapshot-stale windows documented in node_filters).
+    g_of_ext = np.zeros((E,), np.float32)
+    for _ek, _col in ext_index.items():
+        _m = re.search(r"mig-(\d+)g\.", _ek)
+        if _m:
+            g_of_ext[_col] = float(_m.group(1))
+    if g_of_ext.any():
+        # REQUESTED amounts, not the capacity-clamped held table
+        # (rk["extended"]): like the core-resource path, a running MIG
+        # pod on an unknown/overcommitted node still counts toward its
+        # queue's ledger
+        r_mig = np.zeros((M,), np.float32)
+        for _j, _pod in enumerate(running_pods):
+            if _pod.extended:
+                r_mig[_j] = sum(
+                    g_of_ext[ext_index[k]] * v
+                    for k, v in _pod.extended.items()
+                    if k in ext_index)
+        np.add.at(q_alloc[:, 0], rk["queue"][vmask], r_mig[vmask])
+        np.add.at(q_alloc_np[:, 0], rk["queue"][np_mask],
+                  r_mig[np_mask])
     q_request += q_alloc
     pending_req = (gk["task_req"]
                    * gk["task_valid"][:, :, None]).sum(axis=1)  # [G, R]
     np.add.at(q_request, gk["queue"][gk["valid"]],
               pending_req[gk["valid"]])
+    if g_of_ext.any():
+        g_mig = ((gk["task_extended"]
+                  * gk["task_valid"][:, :, None]).sum(axis=1)
+                 @ g_of_ext)                                    # [G]
+        np.add.at(q_request[:, 0], gk["queue"][gk["valid"]],
+                  g_mig[gk["valid"]])
     # historical usage (usagedb feed), normalized usage/clusterCapacity —
     # the k_value term of the DRF waterfill (ref usagedb.go:20-60)
     q_usage = np.zeros((Q, R), np.float32)

@@ -313,37 +313,35 @@ CASES = [
     ),
     Case(
         name="reclaim_mig_simple",
-        ref='reclaim: "Simple reclaim with MIG jobs".  DIVERGENCE '
-            'NOTE: the reference counts a MIG profile\'s g-number '
-            'toward queue GPU quota (resource_info.go '
-            'GetTotalGPURequest); here queue fairness runs on core '
-            'resources, so the jobs pair each instance with a whole '
-            'GPU — the reclaim still frees and re-binds the MIG '
-            'instance (extended credit-back)',
-        nodes=[N("n0", gpu=2, mig={"nvidia.com/mig-1g.10gb": 2})],
+        ref='reclaim: "Simple reclaim with MIG jobs" — pure-MIG jobs: '
+            'the profiles\' g-numbers count toward queue GPU '
+            'accounting (resource_info.go GetTotalGPURequest), so the '
+            'holder queue reads over-share and the reclaimed instance '
+            'credits back to the preemptor',
+        nodes=[N("n0", gpu=8, mig={"nvidia.com/mig-1g.10gb": 2})],
         queues=[Q("qa", quota=1), Q("qb", quota=1)],
-        gangs=[G("a0", queue="qa", tasks=1, gpu=1,
+        gangs=[G("a0", queue="qa", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
-               G("a1", queue="qa", tasks=1, gpu=1,
+               G("a1", queue="qa", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
-               G("b0", queue="qb", tasks=1, gpu=1,
+               G("b0", queue="qb", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1})],
-        # both instances (and both GPUs) held by over-share qa; qb
-        # reclaims one job — its GPU and its MIG instance free together
+        # both instances held by qa (2 GPU-equivalents > 1 deserved);
+        # qb's MIG job reclaims one
         expect={"b0": True},
         expect_evictions=1,
     ),
     Case(
         name="reclaim_mig_within_fair_share_safe",
         ref='reclaim: "Should not reclaim jobs if job is within fair '
-            'share" (hybrid-pod shape, see reclaim_mig_simple note)',
-        nodes=[N("n0", gpu=2, mig={"nvidia.com/mig-1g.10gb": 2})],
+            'share" (pure-MIG jobs, g-number queue accounting)',
+        nodes=[N("n0", gpu=8, mig={"nvidia.com/mig-1g.10gb": 2})],
         queues=[Q("qa", quota=1), Q("qb", quota=1)],
-        gangs=[G("a0", queue="qa", tasks=1, gpu=1,
+        gangs=[G("a0", queue="qa", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
-               G("b-run", queue="qb", tasks=1, gpu=1,
+               G("b-run", queue="qb", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1}, on=["n0"]),
-               G("b0", queue="qb", tasks=1, gpu=1,
+               G("b0", queue="qb", tasks=1, gpu=0,
                  mig={"nvidia.com/mig-1g.10gb": 1})],
         # one instance each: qa is within fair share, no eviction
         expect={"b0": 0},
