@@ -60,6 +60,13 @@ PIPELINE = int(os.environ.get("BENCH_PIPELINE", "5"))
 #: cache key and the device genuinely executes each one.
 _eps_buffers: list = []
 _eps_next = 0
+#: per-PROCESS salt: the cache is content-keyed and persists across
+#: processes, so a counter restarting at 0 every run would replay the
+#: exact (program, inputs) pairs of the previous run and hit the cache
+#: after all.  eps rides outputs only, so magnitude is irrelevant —
+#: but the sequence must stay f32-DISTINCT, so the salt is bounded
+#: (ulp(1000) ≈ 6e-5 < the 1e-3 step)
+_eps_salt = time.time() % 1000.0
 
 
 def _reserve_eps(n: int) -> None:
@@ -70,7 +77,7 @@ def _reserve_eps(n: int) -> None:
     missing = _eps_next + n - len(_eps_buffers)
     if missing > 0:
         base = len(_eps_buffers)
-        block = [jnp.float32((base + i) * 1e-10)
+        block = [jnp.float32(_eps_salt + (base + i) * 1e-3)
                  for i in range(max(missing, 512))]
         jax.block_until_ready(block)
         _eps_buffers.extend(block)
@@ -141,8 +148,13 @@ def bench_fairshare(iters: int) -> dict:
 
     @jax.jit
     def run(state, e):
-        # eps rides the OUTPUT (cache-key variation only): perturbing
-        # DRF's inputs would shift the water-fill loop's convergence
+        # the eps perturbs the DIVIDEND (cluster totals) — request and
+        # limit predicates stay untouched so the water-fill's satisfied
+        # sets cannot oscillate (perturbing `request` measured a
+        # 19-second loop blowup), while the solve subgraph still sees a
+        # distinct input every dispatch (see the cycle benches)
+        state = state.replace(nodes=state.nodes.replace(
+            allocatable=state.nodes.allocatable + e * 1e-10))
         return drf.set_fair_share(state, num_levels=2) + e
 
     p99 = _time(lambda: run(ses.state, _next_eps()), iters)
@@ -166,12 +178,16 @@ def _allocate_bench(name: str, iters: int, pipeline: int | None = None,
 
     @functools.partial(jax.jit, static_argnames=())
     def cycle(state, e):
+        # e (≤ ~5e-10 once scaled, far below the 1e-6 fit-test EPS)
+        # perturbs a SOLVE input: the link's result cache was observed
+        # to serve the solve subgraph separately, so an output-only
+        # eps does not force execution of the part being measured
+        state = state.replace(nodes=state.nodes.replace(
+            free=state.nodes.free + e * 1e-10))
         fair_share = drf.set_fair_share(state, num_levels=num_levels)
         st = state.replace(
             queues=state.queues.replace(fair_share=fair_share))
         res = allocate(st, fair_share, num_levels=num_levels, config=config)
-        # e rides the output so every dispatch has a distinct cache key
-        # without perturbing the solve
         return res.placements, res.allocated, e + 1.0
 
     placements, _, _ = jax.block_until_ready(cycle(ses.state, _next_eps()))
@@ -271,6 +287,9 @@ def bench_headline_full(iters: int) -> dict:
                 1),
             "local_chip_pipelined_estimate_ms": round(
                 max(0.0, out["value"] - floor["link_dispatch_ms"]), 1),
+            "vs_baseline_local_chip": round(
+                50.0 / max(out["value"] - floor["link_dispatch_ms"],
+                           1e-9), 2),
             "note": ("p99_ms: double-buffered (dispatch N+1, gather N); "
                      "sync_p99_ms: nothing in flight.  The link floor "
                      "is MEASURED with a null kernel (zero device "
@@ -337,6 +356,8 @@ def bench_reclaim(iters: int) -> dict:
 
     @functools.partial(jax.jit)
     def cycle(state, e):
+        state = state.replace(nodes=state.nodes.replace(
+            free=state.nodes.free + e * 1e-10))
         res = run_victim_action(
             state, state.queues.fair_share, init_result(state),
             num_levels=num_levels, mode="reclaim", config=config)
@@ -374,6 +395,8 @@ def bench_preempt_many_queues(iters: int) -> dict:
 
     @functools.partial(jax.jit)
     def cycle(state, e):
+        state = state.replace(nodes=state.nodes.replace(
+            free=state.nodes.free + e * 1e-10))
         res = run_victim_action(
             state, state.queues.fair_share, init_result(state),
             num_levels=num_levels, mode="preempt", config=config)
