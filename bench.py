@@ -246,12 +246,15 @@ def bench_headline_full(iters: int) -> dict:
                      ("gang", bench_gang),
                      ("topology", bench_topology),
                      ("reclaim", bench_reclaim),
-                     ("preempt_many_queues", bench_preempt_many_queues)):
+                     ("preempt_many_queues", bench_preempt_many_queues),
+                     ("churn", bench_churn)):
         try:
             r = fn(max(3, iters // 2))
             extra[name] = {"p99_ms": r["value"],
                            "vs_baseline": r["vs_baseline"],
                            "metric": r["metric"]}
+            if r.get("extra"):
+                extra[name]["extra"] = r["extra"]
         except Exception as exc:  # noqa: BLE001 — one config must not
             extra[name] = {"error": str(exc)[:200]}  # sink the artifact
     # honest tails, same session and compiled cycle as the headline:
@@ -279,30 +282,38 @@ def bench_headline_full(iters: int) -> dict:
             max(3, iters // 2),
             shape=tuple(ses.state.gangs.task_valid.shape))
         extra["headline_per_cycle"] = {
+            # HEADLINE NUMBERS — raw measured p99 through the harness
+            # link, nothing subtracted:
             "p99_ms": rdb["value"],
             "sync_p99_ms": r1["value"],
             **floor,
+            # ESTIMATES — floor-subtracted derivations whose null-kernel
+            # calibration (tiny fixed-shape outputs, no state-sized
+            # args) may not match the real cycle's dispatch/transfer
+            # profile; treat as indicative, never as the headline
             "local_chip_estimate_ms": round(
                 max(0.0, r1["value"] - floor["measured_link_floor_ms"]),
                 1),
             "local_chip_pipelined_estimate_ms": round(
                 max(0.0, out["value"] - floor["link_dispatch_ms"]), 1),
-            "vs_baseline_local_chip": round(
+            "vs_baseline_local_chip_estimate": round(
                 50.0 / max(out["value"] - floor["link_dispatch_ms"],
                            1e-9), 2),
             "note": ("p99_ms: double-buffered (dispatch N+1, gather N); "
-                     "sync_p99_ms: nothing in flight.  The link floor "
-                     "is MEASURED with a null kernel (zero device "
-                     "work, commit-sized outputs, distinct inputs so "
-                     "the link's result cache cannot serve it): "
-                     "measured_link_floor_ms = null sync p99 (the full "
-                     "per-sync constant: completion notification + "
-                     "dispatch RPC), link_dispatch_ms = null pipelined "
-                     "p99 (the per-dispatch cost even pipelined "
-                     "batches pay).  local_chip_estimate_ms = sync - "
-                     "floor; local_chip_pipelined_estimate_ms = "
-                     "headline pipelined - link_dispatch (both pure "
-                     "device-solve estimates a local chip would see)")}
+                     "sync_p99_ms: nothing in flight.  Both are RAW "
+                     "measured p99 and are the headline numbers.  The "
+                     "link floor is MEASURED with a null kernel (zero "
+                     "device work, commit-sized outputs, distinct "
+                     "inputs so the link's result cache cannot serve "
+                     "it): measured_link_floor_ms = null sync p99 (the "
+                     "full per-sync constant: completion notification "
+                     "+ dispatch RPC), link_dispatch_ms = null "
+                     "pipelined p99 (the per-dispatch cost even "
+                     "pipelined batches pay).  The *_estimate_* values "
+                     "subtract that floor (sync - floor, and headline "
+                     "pipelined - link_dispatch); the null kernel's "
+                     "profile may not match the real cycle, so they "
+                     "are ESTIMATES, not measurements")}
     except Exception as exc:  # noqa: BLE001
         extra["headline_per_cycle"] = {"error": str(exc)[:200]}
     out["extra"] = extra
@@ -414,6 +425,89 @@ def bench_preempt_many_queues(iters: int) -> dict:
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
 
 
+def bench_churn(iters: int) -> dict:
+    """Snapshot-refresh latency vs churn — the incremental snapshot
+    engine (state/incremental.py) against the full ``build_snapshot``
+    host pass at 10k nodes × 50k pods.  Cycle-to-cycle churn at
+    production scale is a tiny fraction of the cluster, so the refresh
+    should cost O(change): measured at 0.1% / 1% / 10% dirty pods per
+    cycle (evictions + new binds + reap ticks) in the post-binder
+    steady state (running pods carry concrete devices).  Headline value
+    is the 1%-churn p99; ``vs_full`` > 1 means the patch path beats the
+    full rebuild (the acceptance bar is ≥ 5x at ≤ 1%)."""
+    import numpy as np
+
+    from kai_scheduler_tpu.apis import types as apis
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    from kai_scheduler_tpu.state import make_cluster
+    from kai_scheduler_tpu.state.cluster_state import build_snapshot
+    from kai_scheduler_tpu.state.incremental import IncrementalSnapshotter
+
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=10_000, node_accel=8.0, num_gangs=6250,
+        tasks_per_gang=8, running_fraction=0.5)
+    cursor: dict = {}
+    for p in pods:
+        if p.status == apis.PodStatus.RUNNING:
+            c = cursor.get(p.node, 0)
+            p.accel_devices = [c]
+            cursor[p.node] = c + 1
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    snap = IncrementalSnapshotter()
+    snap.refresh(cluster, now=cluster.now)
+
+    lists = cluster.snapshot_lists()
+    full_times = []
+    for _ in range(max(3, iters // 2)):
+        t0 = time.perf_counter()
+        build_snapshot(*lists, now=cluster.now)
+        full_times.append(time.perf_counter() - t0)
+    full_p99 = _p99(full_times)
+
+    rng = np.random.default_rng(0)
+
+    def churn(frac: float) -> None:
+        k = max(1, int(len(cluster.pods) * frac / 2))
+        running = [p.name for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.RUNNING][:k]
+        for nm in running:
+            cluster.evict_pod(nm)
+        pending = [p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING][:k]
+        for p in pending:
+            try:
+                cluster.bind_pod(p.name,
+                                 f"node-{rng.integers(0, 10_000)}")
+            except RuntimeError:
+                pass  # node full — the churn mix, not the refresh, varies
+        cluster.tick()
+
+    extra: dict = {"full_rebuild_p99_ms": round(full_p99, 1)}
+    p99_1pct = None
+    for frac, label in ((0.001, "0.1pct"), (0.01, "1pct"),
+                        (0.10, "10pct")):
+        times = []
+        before = snap.stats.patched
+        for _ in range(max(5, iters)):
+            churn(frac)
+            t0 = time.perf_counter()
+            snap.refresh(cluster, now=cluster.now)
+            times.append(time.perf_counter() - t0)
+        p99 = _p99(times)
+        extra[f"refresh_p99_ms_{label}"] = round(p99, 1)
+        extra[f"speedup_vs_full_{label}"] = round(full_p99 / p99, 1)
+        extra[f"patched_cycles_{label}"] = snap.stats.patched - before
+        if label == "1pct":
+            p99_1pct = p99
+    extra["fallbacks"] = dict(snap.stats.fallbacks)
+    return {"metric": ("incremental snapshot refresh p99 @ 1% churn, "
+                       "10k nodes x 50k pods (vs "
+                       f"{extra['full_rebuild_p99_ms']} ms full rebuild)"),
+            "value": round(p99_1pct, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / max(p99_1pct, 1e-9), 3),
+            "extra": extra}
+
+
 def bench_e2e(iters: int) -> dict:
     """Full production cycle — snapshot → default action pipeline →
     commit, measured as ONE wall-clock number per cycle (the VERDICT r2
@@ -518,6 +612,7 @@ CONFIGS = {
     "4": bench_topology, "topology": bench_topology,
     "5": bench_reclaim, "reclaim": bench_reclaim,
     "preempt_many_queues": bench_preempt_many_queues,
+    "churn": bench_churn,
     "headline": bench_headline,
     "e2e": bench_e2e,
     "e2e_alloc": bench_e2e_alloc,

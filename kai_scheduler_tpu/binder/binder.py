@@ -252,6 +252,7 @@ class Binder:
             if pod is None or pod.status in (apis.PodStatus.SUCCEEDED,
                                              apis.PodStatus.FAILED):
                 br.phase = "Failed"
+                cluster.journal.mark_pod(br.pod_name)
                 result.failed.append(br.pod_name)
                 continue
             if pod.status == apis.PodStatus.RELEASING:
@@ -261,6 +262,7 @@ class Binder:
                 continue
             if pod.status != apis.PodStatus.PENDING:
                 br.phase = "Failed"
+                cluster.journal.mark_pod(br.pod_name)
                 result.failed.append(br.pod_name)
                 continue
             done: list[BinderPlugin] = []
@@ -276,6 +278,7 @@ class Binder:
                 br.failures += 1
                 if br.failures > br.backoff_limit:
                     br.phase = "Failed"
+                    cluster.journal.mark_pod(br.pod_name)
                     result.failed.append(br.pod_name)
                 else:
                     result.retrying.append(br.pod_name)
@@ -283,5 +286,6 @@ class Binder:
             for plugin in self.plugins:
                 plugin.post_bind(cluster, pod, br)
             br.phase = "Succeeded"
+            cluster.journal.mark_pod(br.pod_name)
             result.bound.append(br.pod_name)
         return result

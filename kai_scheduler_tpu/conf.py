@@ -146,6 +146,16 @@ def load_config(doc: dict | str | None,
     if "schedulePeriod" in doc:
         out = dataclasses.replace(
             out, schedule_period_s=float(doc["schedulePeriod"]))
+    if "incremental" in doc:
+        out = dataclasses.replace(out,
+                                  incremental=bool(doc["incremental"]))
+    if "verifyIncremental" in doc:
+        out = dataclasses.replace(
+            out, verify_incremental=bool(doc["verifyIncremental"]))
+    if "incrementalDirtyThreshold" in doc:
+        out = dataclasses.replace(
+            out, incremental_dirty_threshold=float(
+                doc["incrementalDirtyThreshold"]))
     if "pyroscopeAddress" in doc:
         out = dataclasses.replace(
             out, pyroscope_address=str(doc["pyroscopeAddress"] or ""))
@@ -178,6 +188,9 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "tiers": list(placement.tiers),
         },
         "staleGangGracePeriodSeconds": cfg.session.stale_grace_s,
+        "incremental": cfg.incremental,
+        "verifyIncremental": cfg.verify_incremental,
+        "incrementalDirtyThreshold": cfg.incremental_dirty_threshold,
         "pyroscopeAddress": cfg.pyroscope_address,
         # None (unset) round-trips as null: an address alone means
         # 100 Hz, while an explicit 0 disables — collapsing unset to

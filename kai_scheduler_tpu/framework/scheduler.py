@@ -208,6 +208,18 @@ class SchedulerConfig:
     #: a Python runtime); None = unset (an address alone implies
     #: 100 Hz), an explicit 0 disables even with an address
     profiler_sample_hz: float | None = None
+    #: journaled incremental snapshot refresh (state/incremental.py):
+    #: re-derive only dirty rows each cycle instead of the full host
+    #: rebuild, falling back to the full builder on structural change,
+    #: feature pods, or churn above the threshold.  Disabled
+    #: automatically for sharded instances (the shard filter re-shapes
+    #: the object set per cycle).
+    incremental: bool = True
+    #: after every patched refresh, rebuild from scratch and assert the
+    #: patched ClusterState is element-wise identical (debug/CI flag)
+    verify_incremental: bool = False
+    #: dirty fraction above which patching falls back to a full rebuild
+    incremental_dirty_threshold: float = 0.35
 
 
 def apply_shard_args(session: SessionConfig,
@@ -269,6 +281,11 @@ class Scheduler:
         #: clears the shadow.
         self._fit_shadow: dict[str, int] = {}
         self._fit_shadow_cluster = None
+        #: per-cluster incremental snapshotter (weakref-scoped like the
+        #: fit shadow: the HTTP server reuses a Scheduler across
+        #: documents, and a snapshotter only understands ONE journal)
+        self._snapshotter = None
+        self._snapshotter_cluster = None
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
@@ -319,14 +336,35 @@ class Scheduler:
         # _record_fit_status) — a racing snapshot at worst treats a gang
         # as schedulable for one extra cycle, never spuriously
         # unschedulable with a stale reason.
-        session = Session.open(
-            *self._shard_filter(*cluster.snapshot_lists()),
-            config=self.config.session,
-            now=cluster.now, queue_usage=queue_usage,
-            resource_claims=cluster.resource_claims,
-            device_classes=cluster.device_classes,
-            volume_claims=cluster.volume_claims,
-            storage_classes=cluster.storage_classes)
+        if self.config.incremental and self.config.shard is None:
+            # journaled incremental refresh: the snapshotter patches the
+            # previous cycle's snapshot from the cluster's mutation
+            # journal (dirty rows only, changed leaves only to device),
+            # falling back to build_snapshot whenever the patch cannot
+            # be proven identical — see state/incremental.py
+            if (self._snapshotter_cluster is None
+                    or self._snapshotter_cluster() is not cluster):
+                import weakref as _weakref
+
+                from ..state.incremental import IncrementalSnapshotter
+                self._snapshotter = IncrementalSnapshotter(
+                    verify=self.config.verify_incremental,
+                    dirty_threshold=self.config
+                    .incremental_dirty_threshold)
+                self._snapshotter_cluster = _weakref.ref(cluster)
+            state, index = self._snapshotter.refresh(
+                cluster, now=cluster.now, queue_usage=queue_usage)
+            session = Session.from_state(state, index,
+                                         config=self.config.session)
+        else:
+            session = Session.open(
+                *self._shard_filter(*cluster.snapshot_lists()),
+                config=self.config.session,
+                now=cluster.now, queue_usage=queue_usage,
+                resource_claims=cluster.resource_claims,
+                device_classes=cluster.device_classes,
+                volume_claims=cluster.volume_claims,
+                storage_classes=cluster.storage_classes)
         open_s = time.perf_counter() - t0
         metrics.open_session_latency.observe(value=open_s)
         result = CycleResult(tensors=init_result(session.state))

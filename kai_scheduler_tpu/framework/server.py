@@ -136,6 +136,8 @@ def apply_cluster_delta(cluster: Cluster, delta: dict) -> None:
         "storage_classes": (
             lambda d: apis.StorageClass(**d), cluster.storage_classes),
     }
+    from ..wire.codec import _journal_delete, _journal_upsert
+    journal = cluster.journal
     for coll, (parse, store) in parsers.items():
         for doc in delta.get(f"{coll}_upsert", []):
             # partial documents merge over the EXISTING object when the
@@ -149,11 +151,14 @@ def apply_cluster_delta(cluster: Cluster, delta: dict) -> None:
             full.update(doc)
             obj = parse(full)
             key = getattr(obj, "name", None) or obj.pod_name
+            _journal_upsert(journal, coll, key, obj, key in store)
             store[key] = obj
         for name in delta.get(f"{coll}_delete", []):
+            _journal_delete(journal, coll, name, name in store)
             store.pop(name, None)
     if "now" in delta:
         cluster.now = float(delta["now"])
+        journal.mark_time()
 
 
 def run_cycle_doc(doc: dict, scheduler: Scheduler | None = None) -> dict:
@@ -188,9 +193,6 @@ class SchedulerServer:
                  port: int = 0):
         self.cluster = cluster
         self.scheduler = scheduler or Scheduler()
-        # continuous profiling (the Pyroscope analogue): started when
-        # the scheduler config names a push address or a sample rate;
-        # retained windows are always scrapeable once running
         # continuous profiling (the Pyroscope analogue) — created here,
         # STARTED in start() so a never-started server leaks no sampler
         self.profiler = None

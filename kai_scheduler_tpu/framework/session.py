@@ -126,6 +126,16 @@ class Session:
         config = config or SessionConfig()
         state, index = build_snapshot(
             nodes, queues, pod_groups, pods, topology, **snapshot_kwargs)
+        return cls.from_state(state, index, config)
+
+    @classmethod
+    def from_state(cls, state: ClusterState, index: SnapshotIndex,
+                   config: SessionConfig | None = None) -> "Session":
+        """Open a session over an already-built snapshot — the entry the
+        incremental snapshotter uses (``state/incremental.py``): auto-tune
+        the kernel config from the index hints, then run the proportion
+        plugin's share division exactly as :meth:`open` would."""
+        config = config or SessionConfig()
         if config.auto_tune:
             # a hierarchy deeper than the configured recursion would
             # leave leaf levels undivided — widen to the snapshot depth

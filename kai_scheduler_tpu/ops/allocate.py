@@ -480,6 +480,13 @@ def _attempt_gang_in_domain(
     task_class = g.task_filter_class[gang_idx]  # [T]
     task_nom = g.task_nominated[gang_idx]    # [T]
     task_ext = g.task_extended[gang_idx]     # [T, E]
+    if config.extended:
+        # MIG g-number accel equivalents per task (ref resource_info.go
+        # GetTotalGPURequest: totalGpusQuota += gpuPortion * count) —
+        # folded into the QUEUE accel ledger in-cycle so MIG-heavy
+        # queues hit quota/over-share gates the same cycle they place;
+        # node pools keep tracking the extended scalars themselves
+        ext_gq = task_ext @ g.ext_accel      # [T]
     if ext_free is None:
         ext_free = n.extended_free
     if extra_extended_releasing is None:
@@ -585,6 +592,11 @@ def _attempt_gang_in_domain(
                           jnp.inf, state.queues.quota)
     eligible_t = task_valid if legacy else eligible_new         # [T]
     req_valid = jnp.where(eligible_t[:, None], task_req, 0.0)   # [T, R]
+    if config.extended:
+        # the quota/limit prefix gates see the MIG g-equivalents too,
+        # matching the snapshot-side rollups (GetTotalGPURequest)
+        req_valid = req_valid.at[:, 0].add(
+            jnp.where(eligible_t, ext_gq, 0.0))
     cum_req = jnp.cumsum(req_valid, axis=0)                     # [T, R]
     exempt = ~anc[None, :, None]
     gate_lim = jnp.all(
@@ -743,7 +755,11 @@ def _attempt_gang_in_domain(
             ext_l = ext_l.at[node].add(-ext_delta)
             ext_bind = ext_bind.at[node].add(
                 jnp.where(is_pipe, 0.0, ext_delta))
-        q_delta = q_delta + delta
+        delta_queue = delta
+        if config.extended:
+            # queue ledger counts MIG g-equivalents in-cycle
+            delta_queue = delta.at[0].add(jnp.where(placed, ext_gq[t], 0.0))
+        q_delta = q_delta + delta_queue
         # anti-self: the chosen node's whole domain is off-limits for the
         # gang's remaining tasks
         forbidden = forbidden | (
@@ -1264,6 +1280,12 @@ def allocate(
         gang_req_all = jnp.sum(jnp.where(
             (g.task_valid & remaining0[:, None])[:, :, None],
             g.task_req, 0.0), axis=1)                           # [G, R]
+        if config.extended:
+            # the predicted at-pop queue keys see MIG g-equivalents like
+            # the snapshot rollups and the placement queue delta do
+            gang_req_all = gang_req_all.at[:, 0].add(jnp.sum(jnp.where(
+                g.task_valid & remaining0[:, None],
+                g.task_extended @ g.ext_accel, 0.0), axis=1))
         # exclusive per-queue cumulative request along the static job
         # order, O(G·R): queue-major sort, one cumsum, subtract each
         # queue's segment-start prefix (a [G, Q, R] one-hot cumsum

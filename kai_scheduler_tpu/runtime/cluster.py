@@ -41,11 +41,19 @@ class Cluster:
     #: shared-device reservation registry (ref the reservation pods in
     #: kai-resource-reservation; see runtime/reservation.py)
     reservations: "object" = None
+    #: mutation journal — every state change records dirty keys so the
+    #: incremental snapshotter (state/incremental.py) refreshes
+    #: proportional to churn instead of rebuilding per cycle (the
+    #: API-watch role of the reference's cache layer, SURVEY §2.6)
+    journal: "object" = None
 
     def __post_init__(self):
         if self.reservations is None:
             from .reservation import ReservationRegistry
             self.reservations = ReservationRegistry()
+        if self.journal is None:
+            from ..state.incremental import MutationJournal
+            self.journal = MutationJournal()
     #: monotonic clock advanced by the simulation driver
     now: float = 0.0
     #: evicted pods whose workload controller will recreate them (the
@@ -71,9 +79,17 @@ class Cluster:
     def submit(self, group: apis.PodGroup, pods: list[apis.Pod]) -> None:
         """Add a workload (PodGroup + its pods) — podgrouper output."""
         group.creation_timestamp = group.creation_timestamp or self.now
+        if group.name in self.pod_groups:
+            self.journal.mark_gang(group.name)
+        else:
+            self.journal.mark_gang_added(group.name)
         self.pod_groups[group.name] = group
         for p in pods:
             p.creation_timestamp = p.creation_timestamp or self.now
+            if p.name in self.pods:
+                self.journal.mark_pod(p.name)
+            else:
+                self.journal.mark_pod_added(p.name)
             self.pods[p.name] = p
 
     # -- views ------------------------------------------------------------
@@ -125,6 +141,8 @@ class Cluster:
 
     def create_bind_request(self, br: apis.BindRequest) -> None:
         self.bind_requests[br.pod_name] = br
+        # a Pending BindRequest changes the pod's snapshot presentation
+        self.journal.mark_pod(br.pod_name)
 
     def node_device_free(self, node_name: str) -> list[float]:
         """Free share per accel device on a node, from pods' recorded
@@ -191,9 +209,11 @@ class Cluster:
                 pod.accel_devices = fully[:k]
         pod.node = node_name
         pod.status = apis.PodStatus.BOUND
+        self.journal.mark_pod(pod_name)
         group = self.pod_groups.get(pod.group)
         if group is not None and group.last_start_timestamp is None:
             group.last_start_timestamp = self.now
+            self.journal.mark_gang(group.name)
 
     def evict_pod(self, pod_name: str, restart: bool = False) -> None:
         """Eviction = delete pod; its resources become releasing until the
@@ -206,6 +226,7 @@ class Cluster:
         pod = self.pods.get(pod_name)
         if pod is not None:
             pod.status = apis.PodStatus.RELEASING
+            self.journal.mark_pod(pod_name)
             if restart:
                 self.restarting.add(pod_name)
 
@@ -213,6 +234,7 @@ class Cluster:
         """Advance time: bound pods start running, releasing pods vanish
         (or restart as pending, if their controller recreates them)."""
         self.now += seconds
+        self.journal.mark_time()
         for name in list(self.pods):
             pod = self.pods[name]
             if pod.status == apis.PodStatus.RELEASING:
@@ -231,7 +253,10 @@ class Cluster:
                     pod.status = apis.PodStatus.PENDING
                     pod.node = None
                     pod.accel_devices = []
+                    self.journal.mark_pod(name)
                 else:
                     del self.pods[name]
+                    self.journal.mark_pod_removed(name)
             elif pod.status == apis.PodStatus.BOUND:
                 pod.status = apis.PodStatus.RUNNING
+                self.journal.mark_pod(name)

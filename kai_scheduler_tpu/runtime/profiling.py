@@ -105,6 +105,10 @@ class ContinuousProfiler:
 
     def start(self) -> "ContinuousProfiler":
         if self._thread is None:
+            # stop() leaves the event set; without clearing it a
+            # re-started sampler thread would exit immediately and
+            # silently stop profiling
+            self._stop.clear()
             self._window_start = time.time()
             self._thread = threading.Thread(
                 target=self._run, name="continuous-profiler", daemon=True)

@@ -4,7 +4,13 @@ from .cluster_state import (  # noqa: F401
     NodeState,
     QueueState,
     RunningState,
+    SnapshotCapacity,
     SnapshotIndex,
     build_snapshot,
+)
+from .incremental import (  # noqa: F401
+    IncrementalSnapshotter,
+    IncrementalVerifyError,
+    MutationJournal,
 )
 from .synthetic import make_cluster  # noqa: F401

@@ -171,6 +171,11 @@ class GangState(struct.PyTreeNode):
     sig: jax.Array                # i32 [G]
     #: extended scalar requests per task (MIG profiles; ref migResources)
     task_extended: jax.Array      # f32 [G, T, E]
+    #: accel g-number equivalent per extended key (MIG g-slices, ref
+    #: resource_info.go GetTotalGPURequest) — lets the placement kernels
+    #: fold MIG requests into the in-cycle queue accel ledger; zeros
+    #: for non-MIG keys and when the snapshot has no extended resources
+    ext_accel: jax.Array          # f32 [E]
     #: accel devices requested via DRA claims per task (ref draGpuCounts;
     #: already folded into task_req accel for accounting)
     task_dra: jax.Array           # i32 [G, T]
@@ -325,9 +330,212 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+def dense_row_ids(mat: "np.ndarray") -> "np.ndarray":
+    """Dense ids over distinct rows, identical to
+    ``np.unique(mat, axis=0, return_inverse=True)[1]`` (ids index the
+    lexicographically sorted distinct rows) but ~50x faster at the
+    scheduling-signature shape: ``unique(axis=0)`` compares rows as
+    void scalars, one memcmp per comparison, while a column lexsort +
+    neighbor compare stays fully vectorized."""
+    if not len(mat):
+        return np.zeros((0,), np.int64)
+    order = np.lexsort(mat.T[::-1])
+    s = mat[order]
+    neq = np.any(s[1:] != s[:-1], axis=1)
+    ranks = np.concatenate([[0], np.cumsum(neq)])
+    inv = np.empty(len(mat), np.int64)
+    inv[order] = ranks
+    return inv
+
+
 #: leader-role label values — ref plugins/kubeflow (job-role master/
 #: launcher) and plugins/ray (node-type head)
 _LEADER_ROLES = ("master", "launcher", "head")
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotCapacity:
+    """Padded-size floors for the snapshot axes.
+
+    The incremental snapshotter (``state/incremental.py``) pins these so
+    consecutive cycles keep identical compiled shapes while entity
+    counts drift — capacity only grows (with slack) at full rebuilds,
+    mirroring how the reference's cache rarely reallocates.  Zero floors
+    keep the plain count-derived padding.
+    """
+
+    nodes: int = 0
+    queues: int = 0
+    gangs: int = 0
+    tasks: int = 0
+    running: int = 0
+    types: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Per-section builders — factored out of build_snapshot so the
+# incremental snapshotter (state/incremental.py) re-derives sections
+# from cached encodes through the SAME code paths the full build runs.
+# ---------------------------------------------------------------------------
+
+
+def build_queue_tables(queues: list[apis.Queue], Q: int) -> dict:
+    """Per-queue static tables + minruntime hierarchy resolution.
+
+    Ref ``api/queue_info`` and ``plugins/minruntime`` (resolver.go) —
+    see the inline comments.  Returns every ``q_*`` array keyed by name
+    plus ``q_index``/``queue_names``.
+    """
+    queue_names = [q.name for q in queues]
+    q_index = {name: i for i, name in enumerate(queue_names)}
+    q_parent = np.full((Q,), -1, np.int32)
+    q_depth = np.zeros((Q,), np.int32)
+    q_priority = np.zeros((Q,), np.int32)
+    q_quota = np.zeros((Q, R), np.float32)
+    q_oqw = np.zeros((Q, R), np.float32)
+    q_limit = np.full((Q, R), UNLIMITED, np.float32)
+    q_valid = np.zeros((Q,), bool)
+    q_creation = np.zeros((Q,), np.int32)
+    q_preempt_mrt = np.zeros((Q,), np.float32)
+    q_reclaim_mrt = np.zeros((Q,), np.float32)
+    for i, q in enumerate(queues):
+        q_valid[i] = True
+        q_priority[i] = q.priority
+        q_creation[i] = i
+        q_preempt_mrt[i] = q.preempt_min_runtime
+        q_reclaim_mrt[i] = q.reclaim_min_runtime
+        if q.parent is not None:
+            q_parent[i] = q_index[q.parent]
+        for r in range(R):
+            qr = q.resource(r)
+            q_quota[i, r] = qr.quota
+            q_oqw[i, r] = qr.over_quota_weight
+            q_limit[i, r] = qr.limit
+    # depth by chasing parents (hierarchy is shallow; bounded loop)
+    for i in range(len(queues)):
+        d, p = 0, int(q_parent[i])
+        while p >= 0:
+            d, p = d + 1, int(q_parent[p])
+        q_depth[i] = d
+
+    # --- minruntime hierarchy resolution (ref plugins/minruntime) ---------
+    def _inherit(vals: np.ndarray) -> np.ndarray:
+        """First set (>0) value walking self → root; 0 when none."""
+        eff = vals.copy()
+        cur = q_parent.copy()
+        for _ in range(int(q_depth.max(initial=0)) + 1):
+            unset = (eff <= 0) & (cur >= 0)
+            if not unset.any():
+                break
+            eff[unset] = vals[cur[unset]]
+            cur = np.where(cur >= 0, q_parent[np.maximum(cur, 0)], -1)
+        return np.maximum(eff, 0.0)
+
+    q_preempt_eff = _inherit(q_preempt_mrt)
+    if not (q_reclaim_mrt > 0).any():
+        # common case: no queue configures reclaim minruntime — skip the
+        # O(Q^2 x depth) pairwise LCA resolution entirely
+        q_reclaim_eff = np.zeros((Q, Q), np.float32)
+    else:
+        # ancestor-at-depth table for the LCA walk (top-level first)
+        maxd = int(q_depth.max(initial=0)) + 1
+        anc_at = np.full((Q, maxd), -1, np.int64)
+        for i in range(len(queues)):
+            chain_q, p = [i], int(q_parent[i])
+            while p >= 0:
+                chain_q.append(p)
+                p = int(q_parent[p])
+            for d, qx in enumerate(reversed(chain_q)):
+                anc_at[i, d] = qx
+        # match depth per (victim, reclaimer) pair; start queue = the
+        # victim-side child of the LCA (clamped to the victim's leaf;
+        # different top-level queues degenerate to the victim's top-level
+        # queue — the "shadow parent" rule in resolver.go)
+        eq = (anc_at[:, None, :] == anc_at[None, :, :]) & (
+            anc_at[:, None, :] >= 0)                          # [Q, Q, D]
+        match_d = (eq * (np.arange(maxd) + 1)).max(axis=-1) - 1
+        start_d = np.minimum(match_d + 1,
+                             q_depth[:, None].astype(np.int64))
+        start_q = np.take_along_axis(
+            np.broadcast_to(anc_at[:, None, :], (Q, Q, maxd)),
+            start_d[:, :, None], axis=2)[:, :, 0]             # [Q, Q]
+        q_reclaim_inh = _inherit(q_reclaim_mrt)
+        q_reclaim_eff = q_reclaim_inh[np.maximum(start_q, 0)]
+        q_reclaim_eff[start_q < 0] = 0.0
+    return dict(
+        queue_names=queue_names, q_index=q_index, q_parent=q_parent,
+        q_depth=q_depth, q_priority=q_priority, q_quota=q_quota,
+        q_oqw=q_oqw, q_limit=q_limit, q_valid=q_valid,
+        q_creation=q_creation, q_preempt_mrt=q_preempt_mrt,
+        q_reclaim_mrt=q_reclaim_mrt, q_preempt_eff=q_preempt_eff,
+        q_reclaim_eff=q_reclaim_eff)
+
+
+def derive_rollups(*, node_alloc, claim_used, rk, gk, g_of_ext, r_mig,
+                   queue_usage, q_index, q_parent, q_depth,
+                   num_queues) -> dict:
+    """Derived node free/releasing + queue allocated/request/usage
+    rollups — the host mirror of the queuecontroller status (vectorized
+    scatter-adds over the running/pending tables).  Shared verbatim by
+    the full build and the incremental patch path so both derive
+    bit-identical ledgers from the same section tables.
+    """
+    N = node_alloc.shape[0]
+    Q = q_parent.shape[0]
+    node_used = np.zeros((N, R), np.float32)
+    node_rel = np.zeros((N, R), np.float32)
+    on_node = rk["valid"] & (rk["node"] >= 0)
+    rel_m = on_node & rk["releasing"]
+    used_m = on_node & ~rk["releasing"]
+    # unknown nodes count for queues, not for node capacity
+    np.add.at(node_rel, rk["node"][rel_m], rk["req"][rel_m])
+    np.add.at(node_used, rk["node"][used_m], rk["req"][used_m])
+    node_free = np.maximum(
+        node_alloc - node_used - node_rel - claim_used, 0.0)
+
+    q_alloc = np.zeros((Q, R), np.float32)
+    q_alloc_np = np.zeros((Q, R), np.float32)
+    q_request = np.zeros((Q, R), np.float32)
+    vmask = rk["valid"]
+    np.add.at(q_alloc, rk["queue"][vmask], rk["req"][vmask])
+    np_mask = vmask & ~rk["preemptible"]
+    np.add.at(q_alloc_np, rk["queue"][np_mask], rk["req"][np_mask])
+    # The MIG g-equivalents enter the rollups — REQUESTED amounts, not
+    # the capacity-clamped held table (rk["extended"]): like the
+    # core-resource path, a running MIG pod on an unknown/overcommitted
+    # node still counts toward its queue's ledger.
+    if g_of_ext.any():
+        np.add.at(q_alloc[:, 0], rk["queue"][vmask], r_mig[vmask])
+        np.add.at(q_alloc_np[:, 0], rk["queue"][np_mask],
+                  r_mig[np_mask])
+    q_request += q_alloc
+    pending_req = (gk["task_req"]
+                   * gk["task_valid"][:, :, None]).sum(axis=1)  # [G, R]
+    np.add.at(q_request, gk["queue"][gk["valid"]],
+              pending_req[gk["valid"]])
+    if g_of_ext.any():
+        g_mig = ((gk["task_extended"]
+                  * gk["task_valid"][:, :, None]).sum(axis=1)
+                 @ g_of_ext)                                    # [G]
+        np.add.at(q_request[:, 0], gk["queue"][gk["valid"]],
+                  g_mig[gk["valid"]])
+    # historical usage (usagedb feed), normalized usage/clusterCapacity —
+    # the k_value term of the DRF waterfill (ref usagedb.go:20-60)
+    q_usage = np.zeros((Q, R), np.float32)
+    if queue_usage:
+        for qname, vec in queue_usage.items():
+            qi2 = q_index.get(qname)
+            if qi2 is not None:
+                q_usage[qi2] = np.asarray(vec, np.float32)
+    # propagate to parents (requests/allocations roll up the hierarchy)
+    for arr in (q_alloc, q_alloc_np, q_request, q_usage):
+        for i in sorted(range(num_queues), key=lambda i: -q_depth[i]):
+            p = q_parent[i]
+            if p >= 0:
+                arr[p] += arr[i]
+    return dict(node_rel=node_rel, node_free=node_free, q_alloc=q_alloc,
+                q_alloc_np=q_alloc_np, q_request=q_request,
+                q_usage=q_usage)
 
 
 # ---------------------------------------------------------------------------
@@ -430,6 +638,8 @@ def build_snapshot(
     device_classes: dict[str, apis.DeviceClass] | None = None,
     volume_claims: dict[str, apis.PersistentVolumeClaim] | None = None,
     storage_classes: dict[str, apis.StorageClass] | None = None,
+    capacity: SnapshotCapacity | None = None,
+    _return_host: bool = False,
 ) -> tuple[ClusterState, SnapshotIndex]:
     """Flatten API objects into a ClusterState (+ index for the commit path).
 
@@ -437,6 +647,7 @@ def build_snapshot(
     (``cache/cluster_info/cluster_info.go:229`` snapshotNodes,
     ``:346`` snapshotPodGroups).
     """
+    cap = capacity or SnapshotCapacity()
     # --- vocabularies -----------------------------------------------------
     selector_keys: list[str] = []
     for pod in pods:
@@ -483,10 +694,22 @@ def build_snapshot(
         | {k for p in pods for k in p.extended})
     E = max(1, len(ext_keys))
     ext_index = {k: i for i, k in enumerate(ext_keys)}
+    # MIG profiles count their g-number toward queue GPU accounting
+    # (ref resource_info.go GetTotalGPURequest: totalGpusQuota +=
+    # gpuPortion * count).  The per-key g-equivalent vector feeds the
+    # snapshot rollups below AND ships with the state (GangState.
+    # ext_accel) so the placement kernels apply the same equivalents to
+    # their in-cycle queue deltas — MIG-heavy queues hit quota and
+    # over-share gates in the cycle that places them.
+    g_of_ext = np.zeros((E,), np.float32)
+    for _ek, _col in ext_index.items():
+        _m = re.search(r"mig-(\d+)g\.", _ek)
+        if _m:
+            g_of_ext[_col] = float(_m.group(1))
 
     # --- nodes ------------------------------------------------------------
     live_nodes = [n for n in nodes if not n.unschedulable]
-    N = _round_up(len(live_nodes), pad)
+    N = _round_up(max(len(live_nodes), cap.nodes), pad)
     node_alloc = np.zeros((N, R), np.float32)
     node_labels = np.full((N, K), -1, np.int32)
     node_topo = np.full((N, L), -1, np.int32)
@@ -539,83 +762,14 @@ def build_snapshot(
             off += len(t.levels)
 
     # --- queues (parents before children) --------------------------------
-    queue_names = [q.name for q in queues]
-    q_index = {name: i for i, name in enumerate(queue_names)}
-    Q = _round_up(len(queues), pad)
-    q_parent = np.full((Q,), -1, np.int32)
-    q_depth = np.zeros((Q,), np.int32)
-    q_priority = np.zeros((Q,), np.int32)
-    q_quota = np.zeros((Q, R), np.float32)
-    q_oqw = np.zeros((Q, R), np.float32)
-    q_limit = np.full((Q, R), UNLIMITED, np.float32)
-    q_valid = np.zeros((Q,), bool)
-    q_creation = np.zeros((Q,), np.int32)
-    q_preempt_mrt = np.zeros((Q,), np.float32)
-    q_reclaim_mrt = np.zeros((Q,), np.float32)
-    for i, q in enumerate(queues):
-        q_valid[i] = True
-        q_priority[i] = q.priority
-        q_creation[i] = i
-        q_preempt_mrt[i] = q.preempt_min_runtime
-        q_reclaim_mrt[i] = q.reclaim_min_runtime
-        if q.parent is not None:
-            q_parent[i] = q_index[q.parent]
-        for r in range(R):
-            qr = q.resource(r)
-            q_quota[i, r] = qr.quota
-            q_oqw[i, r] = qr.over_quota_weight
-            q_limit[i, r] = qr.limit
-    # depth by chasing parents (hierarchy is shallow; bounded loop)
-    for i in range(len(queues)):
-        d, p = 0, int(q_parent[i])
-        while p >= 0:
-            d, p = d + 1, int(q_parent[p])
-        q_depth[i] = d
-
-    # --- minruntime hierarchy resolution (ref plugins/minruntime) ---------
-    def _inherit(vals: np.ndarray) -> np.ndarray:
-        """First set (>0) value walking self → root; 0 when none."""
-        eff = vals.copy()
-        cur = q_parent.copy()
-        for _ in range(int(q_depth.max(initial=0)) + 1):
-            unset = (eff <= 0) & (cur >= 0)
-            if not unset.any():
-                break
-            eff[unset] = vals[cur[unset]]
-            cur = np.where(cur >= 0, q_parent[np.maximum(cur, 0)], -1)
-        return np.maximum(eff, 0.0)
-
-    q_preempt_eff = _inherit(q_preempt_mrt)
-    if not (q_reclaim_mrt > 0).any():
-        # common case: no queue configures reclaim minruntime — skip the
-        # O(Q^2 x depth) pairwise LCA resolution entirely
-        q_reclaim_eff = np.zeros((Q, Q), np.float32)
-    else:
-        # ancestor-at-depth table for the LCA walk (top-level first)
-        maxd = int(q_depth.max(initial=0)) + 1
-        anc_at = np.full((Q, maxd), -1, np.int64)
-        for i in range(len(queues)):
-            chain_q, p = [i], int(q_parent[i])
-            while p >= 0:
-                chain_q.append(p)
-                p = int(q_parent[p])
-            for d, qx in enumerate(reversed(chain_q)):
-                anc_at[i, d] = qx
-        # match depth per (victim, reclaimer) pair; start queue = the
-        # victim-side child of the LCA (clamped to the victim's leaf;
-        # different top-level queues degenerate to the victim's top-level
-        # queue — the "shadow parent" rule in resolver.go)
-        eq = (anc_at[:, None, :] == anc_at[None, :, :]) & (
-            anc_at[:, None, :] >= 0)                          # [Q, Q, D]
-        match_d = (eq * (np.arange(maxd) + 1)).max(axis=-1) - 1
-        start_d = np.minimum(match_d + 1,
-                             q_depth[:, None].astype(np.int64))
-        start_q = np.take_along_axis(
-            np.broadcast_to(anc_at[:, None, :], (Q, Q, maxd)),
-            start_d[:, :, None], axis=2)[:, :, 0]             # [Q, Q]
-        q_reclaim_inh = _inherit(q_reclaim_mrt)
-        q_reclaim_eff = q_reclaim_inh[np.maximum(start_q, 0)]
-        q_reclaim_eff[start_q < 0] = 0.0
+    Q = _round_up(max(len(queues), cap.queues), pad)
+    qt = build_queue_tables(queues, Q)
+    queue_names, q_index = qt["queue_names"], qt["q_index"]
+    q_parent, q_depth = qt["q_parent"], qt["q_depth"]
+    q_priority, q_quota, q_oqw = qt["q_priority"], qt["q_quota"], qt["q_oqw"]
+    q_limit, q_valid, q_creation = qt["q_limit"], qt["q_valid"], qt["q_creation"]
+    q_preempt_mrt, q_reclaim_mrt = qt["q_preempt_mrt"], qt["q_reclaim_mrt"]
+    q_preempt_eff, q_reclaim_eff = qt["q_preempt_eff"], qt["q_reclaim_eff"]
 
     # --- pod groups + tasks ----------------------------------------------
     group_names = [g.name for g in pod_groups]
@@ -637,8 +791,8 @@ def build_snapshot(
             f"max_tasks_per_gang={T} < largest gang ({max_pending} pending "
             "tasks); truncating would starve gangs whose min_member exceeds "
             "the cap")
-    T = _round_up(T, 4)
-    G = _round_up(len(pod_groups), pad)
+    T = _round_up(max(T, cap.tasks), 4)
+    G = _round_up(max(len(pod_groups), cap.gangs), pad)
     gk = dict(
         queue=np.zeros((G,), np.int32),
         min_member=np.zeros((G,), np.int32),
@@ -666,6 +820,7 @@ def build_snapshot(
         task_type=np.zeros((G, T), np.int32),
         sig=np.zeros((G,), np.int32),
         task_extended=np.zeros((G, T, E), np.float32),
+        ext_accel=g_of_ext,
         task_dra=np.zeros((G, T), np.int32),
     )
     # --- subgroup tables (slot 0 = implicit default subgroup, so the
@@ -712,6 +867,12 @@ def build_snapshot(
             return pod.dra_accel_count, ()
         cnt, min_mem, bad = 0, 0.0, False
         sels: list[tuple[str, str]] = []
+        #: this pod's provisional admissions — committed to the cycle
+        #: counter only if the pod passes EVERY gate, so one rejected
+        #: claim cannot inflate the virtual consumer count other claims
+        #: see for later pods (the reference never grows ReservedFor for
+        #: a pod its preFilter rejected)
+        admit: dict[str, int] = {}
         for cname in pod.resource_claims:
             claim = resource_claims.get(cname)
             if claim is None:
@@ -719,15 +880,16 @@ def build_snapshot(
             dc = (device_classes or {}).get(claim.device_class)
             is_accel = dc is None or dc.accel
             if queue_name is not None:
-                taken = claim.reserved_for + claim_admitted.get(cname, 0)
+                taken = (claim.reserved_for
+                         + claim_admitted.get(cname, 0)
+                         + admit.get(cname, 0))
                 bad_label = (is_accel and not claim.from_template
                              and claim.labels.get(apis.QUEUE_LABEL)
                              != queue_name)
                 if taken >= apis.RESERVED_FOR_MAX or bad_label:
                     bad = True
                 else:
-                    claim_admitted[cname] = \
-                        claim_admitted.get(cname, 0) + 1
+                    admit[cname] = admit.get(cname, 0) + 1
             if dc is not None:
                 min_mem = max(min_mem, dc.min_memory_gib)
                 sels.extend(sorted(dc.node_selector.items()))
@@ -735,6 +897,8 @@ def build_snapshot(
                 cnt += claim.count
         if bad:
             return cnt, (float("inf"), ())
+        for cname, inc in admit.items():
+            claim_admitted[cname] = claim_admitted.get(cname, 0) + inc
         key = (min_mem, tuple(sels)) if (min_mem or sels) else ()
         return cnt, key
 
@@ -1195,7 +1359,7 @@ def build_snapshot(
     # Pods whose node is missing from the snapshot (cordoned/deleted) keep
     # valid=True with node=-1: they still count toward queue allocation so
     # DRF fairness stays honest, but victim kernels skip node<0 rows.
-    M = _round_up(len(running_pods), pad)
+    M = _round_up(max(len(running_pods), cap.running), pad)
     node_idx = {name: i for i, name in enumerate(node_names)}
     rk = dict(
         req=np.zeros((M, R), np.float32),
@@ -1408,7 +1572,7 @@ def build_snapshot(
         gk["subgroup_min_member"] - sub_running, 0)
 
     # --- task-type table + scheduling signatures --------------------------
-    Y = _round_up(max(len(task_type_index), 1), 4)
+    Y = _round_up(max(len(task_type_index), 1, cap.types), 4)
     gk["type_req"] = np.zeros((Y, R), np.float32)
     gk["type_selector"] = np.full((Y, K), -1, np.int32)
     gk["type_portion"] = np.zeros((Y,), np.float32)
@@ -1442,84 +1606,30 @@ def build_snapshot(
         gk["preemptible"][:, None].astype(np.int64),
         (~gk["valid"][:, None]).astype(np.int64),
     ], axis=1, dtype=np.int64)
-    _, inv = np.unique(sig_mat, axis=0, return_inverse=True)
-    gk["sig"] = inv.astype(np.int32)
+    gk["sig"] = dense_row_ids(sig_mat).astype(np.int32)
 
-    # --- derived node free / releasing (vectorized scatter-adds) ---------
-    node_used = np.zeros((N, R), np.float32)
-    node_rel = np.zeros((N, R), np.float32)
-    on_node = rk["valid"] & (rk["node"] >= 0)
-    rel_m = on_node & rk["releasing"]
-    used_m = on_node & ~rk["releasing"]
-    # unknown nodes count for queues, not for node capacity
-    np.add.at(node_rel, rk["node"][rel_m], rk["req"][rel_m])
-    np.add.at(node_used, rk["node"][used_m], rk["req"][used_m])
-    node_free = np.maximum(
-        node_alloc - node_used - node_rel - claim_used, 0.0)
-
-    # --- derived queue allocated / request (host mirror of
-    #     queuecontroller status; kernels recompute on-device when needed) --
-    q_alloc = np.zeros((Q, R), np.float32)
-    q_alloc_np = np.zeros((Q, R), np.float32)
-    q_request = np.zeros((Q, R), np.float32)
-    vmask = rk["valid"]
-    np.add.at(q_alloc, rk["queue"][vmask], rk["req"][vmask])
-    np_mask = vmask & ~rk["preemptible"]
-    np.add.at(q_alloc_np, rk["queue"][np_mask], rk["req"][np_mask])
-    # MIG profiles count their g-number toward queue GPU accounting
-    # (ref resource_info.go GetTotalGPURequest: totalGpusQuota +=
-    # gpuPortion * count).  The g-equivalents enter the SNAPSHOT
-    # rollups — allocated, request, and through them the fairness
-    # division — so over-share detection and the reclaim gates fire for
-    # pure-MIG queues.  In-cycle placement deltas remain core-resource;
-    # a cycle's own MIG placements show up in the next snapshot
-    # (bounded staleness, same convergence class as the other
-    # snapshot-stale windows documented in node_filters).
-    g_of_ext = np.zeros((E,), np.float32)
-    for _ek, _col in ext_index.items():
-        _m = re.search(r"mig-(\d+)g\.", _ek)
-        if _m:
-            g_of_ext[_col] = float(_m.group(1))
+    # --- derived node free/releasing + queue rollups (shared section) ----
+    # The MIG g-equivalents enter the SNAPSHOT rollups — allocated,
+    # request, and through them the fairness division — AND (via
+    # GangState.ext_accel) the in-cycle placement queue deltas, so
+    # over-share detection and the quota/reclaim gates fire for
+    # pure-MIG queues in the same cycle (ref GetTotalGPURequest).
+    r_mig = np.zeros((M,), np.float32)
     if g_of_ext.any():
-        # REQUESTED amounts, not the capacity-clamped held table
-        # (rk["extended"]): like the core-resource path, a running MIG
-        # pod on an unknown/overcommitted node still counts toward its
-        # queue's ledger
-        r_mig = np.zeros((M,), np.float32)
         for _j, _pod in enumerate(running_pods):
             if _pod.extended:
                 r_mig[_j] = sum(
                     g_of_ext[ext_index[k]] * v
                     for k, v in _pod.extended.items()
                     if k in ext_index)
-        np.add.at(q_alloc[:, 0], rk["queue"][vmask], r_mig[vmask])
-        np.add.at(q_alloc_np[:, 0], rk["queue"][np_mask],
-                  r_mig[np_mask])
-    q_request += q_alloc
-    pending_req = (gk["task_req"]
-                   * gk["task_valid"][:, :, None]).sum(axis=1)  # [G, R]
-    np.add.at(q_request, gk["queue"][gk["valid"]],
-              pending_req[gk["valid"]])
-    if g_of_ext.any():
-        g_mig = ((gk["task_extended"]
-                  * gk["task_valid"][:, :, None]).sum(axis=1)
-                 @ g_of_ext)                                    # [G]
-        np.add.at(q_request[:, 0], gk["queue"][gk["valid"]],
-                  g_mig[gk["valid"]])
-    # historical usage (usagedb feed), normalized usage/clusterCapacity —
-    # the k_value term of the DRF waterfill (ref usagedb.go:20-60)
-    q_usage = np.zeros((Q, R), np.float32)
-    if queue_usage:
-        for qname, vec in queue_usage.items():
-            qi2 = q_index.get(qname)
-            if qi2 is not None:
-                q_usage[qi2] = np.asarray(vec, np.float32)
-    # propagate to parents (requests/allocations roll up the hierarchy)
-    for arr in (q_alloc, q_alloc_np, q_request, q_usage):
-        for i in sorted(range(len(queues)), key=lambda i: -q_depth[i]):
-            p = q_parent[i]
-            if p >= 0:
-                arr[p] += arr[i]
+    roll = derive_rollups(
+        node_alloc=node_alloc, claim_used=claim_used, rk=rk, gk=gk,
+        g_of_ext=g_of_ext, r_mig=r_mig, queue_usage=queue_usage,
+        q_index=q_index, q_parent=q_parent, q_depth=q_depth,
+        num_queues=len(queues))
+    node_rel, node_free = roll["node_rel"], roll["node_free"]
+    q_alloc, q_alloc_np = roll["q_alloc"], roll["q_alloc_np"]
+    q_request, q_usage = roll["q_request"], roll["q_usage"]
 
     # --- evaluate filter classes against nodes (host, once per spec) ------
     running_views = [
@@ -1607,6 +1717,7 @@ def build_snapshot(
                         attract_static=attract_static),
         running=RunningState(**rk),
     )
+    host_state = state
     state = jax.device_put(state)
     index = SnapshotIndex(
         node_names=node_names,
@@ -1651,4 +1762,8 @@ def build_snapshot(
             and bool((gk["anti_self_level"] < 0).all())
             and bool((gk["subgroup_required_level"] < 0).all())),
     )
+    if _return_host:
+        # the incremental snapshotter caches the pre-device_put numpy
+        # leaves so later cycles can patch rows and ship only changes
+        return state, index, host_state
     return state, index

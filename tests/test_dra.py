@@ -177,3 +177,37 @@ def test_mig_gang_reclaims_mig_victim():
     # the MIG gang is placed (pipelined onto the victim's capacity)
     assert allocated.any()
     assert (placements >= 0).any()
+
+
+def test_rejected_pod_does_not_inflate_claim_consumers():
+    """A pod rejected by ANY claim gate must not grow the virtual
+    ReservedFor count of its OTHER claims: per-claim admissions commit
+    only after the pod passes every gate (the reference's preFilter
+    never reserves for a pod it rejected).  Previously pod A's good
+    claim was counted even though A was rejected, pushing the shared
+    claim to its consumer cap and wrongly rejecting pod B."""
+    cluster = _dra_cluster()
+    # one consumer slot left on the shared claim
+    cluster.resource_claims["c-share"] = apis.ResourceClaim(
+        name="c-share", device_class="any-gpu", count=1,
+        from_template=False,
+        labels={apis.QUEUE_LABEL: "q"},
+        reserved_for=apis.RESERVED_FOR_MAX - 1)
+    # a shared claim missing the queue label — always rejected
+    cluster.resource_claims["c-bad"] = apis.ResourceClaim(
+        name="c-bad", device_class="any-gpu", count=1,
+        from_template=False)
+    ga = apis.PodGroup(name="pg-a", queue="q", min_member=1)
+    pod_a = apis.Pod(name="pod-a", group="pg-a",
+                     resources=apis.ResourceVec(0.0, 1.0, 1.0),
+                     resource_claims=["c-share", "c-bad"])
+    gb = apis.PodGroup(name="pg-b", queue="q", min_member=1)
+    pod_b = apis.Pod(name="pod-b", group="pg-b",
+                     resources=apis.ResourceVec(0.0, 1.0, 1.0),
+                     resource_claims=["c-share"])
+    cluster.submit(ga, [pod_a])
+    cluster.submit(gb, [pod_b])
+    result = Scheduler().run_once(cluster)
+    bound = {br.pod_name for br in result.bind_requests}
+    assert "pod-a" not in bound   # its c-bad gate rejects it
+    assert "pod-b" in bound       # the last consumer slot is still free

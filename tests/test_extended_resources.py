@@ -77,3 +77,28 @@ def test_dra_counts_add_to_accel_accounting():
     # the DRA pod takes both devices; the whole-device pod cannot fit
     assert "p0" in by_name and "p1" not in by_name
     assert len(by_name["p0"].resource_claim_allocations) == 2
+
+
+def test_mig_g_equivalents_gate_queue_limit_in_cycle():
+    """MIG g-number equivalents enter the placement's in-cycle queue
+    delta (ref resource_info.go GetTotalGPURequest), so a queue's hard
+    accel limit stops MIG placements in the SAME cycle — previously a
+    cycle's own MIG placements only reached the ledger at the next
+    snapshot (bounded staleness, closed this round)."""
+    nodes = [apis.Node("mig", apis.ResourceVec(0, 64, 256),
+                       extended={MIG: 4.0})]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=100,
+                                                       limit=2.0))]
+    groups = [apis.PodGroup(f"g{i}", queue="q", min_member=1)
+              for i in range(2)]
+    # each pod asks 2 x 1g slices = 2 accel g-equivalents; the node
+    # fits both (4 slices), only the queue limit can stop the second
+    pods = [apis.Pod(f"p{i}", f"g{i}", apis.ResourceVec(0, 1, 1),
+                     extended={MIG: 2.0}) for i in range(2)]
+    state, _ = build_snapshot(nodes, queues, groups, pods)
+    assert float(np.asarray(state.gangs.ext_accel)[0]) == 1.0  # 1g key
+    res = run_allocate(state)
+    allocated = np.asarray(res.allocated)
+    assert int(allocated.sum()) == 1
+    # the committed queue ledger carries the g-equivalents
+    assert float(np.asarray(res.queue_allocated)[0, 0]) == 2.0

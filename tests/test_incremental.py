@@ -1,0 +1,375 @@
+"""Incremental snapshot engine tests — journaled dirty-set refresh
+(``state/incremental.py``).
+
+The load-bearing property: a PATCHED snapshot must be element-wise
+identical to a fresh full ``build_snapshot`` — every ``ClusterState``
+leaf and every ``SnapshotIndex`` name map.  ``IncrementalSnapshotter``
+(verify=True) asserts exactly that after every patch, so these tests
+drive churn through it and then check the patch path actually engaged
+(a fallback-to-full would pass verification vacuously).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.apis import types as apis
+from kai_scheduler_tpu.binder import Binder
+from kai_scheduler_tpu.framework.scheduler import Scheduler, SchedulerConfig
+from kai_scheduler_tpu.runtime.cluster import Cluster
+from kai_scheduler_tpu.state import make_cluster
+from kai_scheduler_tpu.state.incremental import (
+    IncrementalSnapshotter,
+    MutationJournal,
+)
+
+pytestmark = pytest.mark.core
+
+
+def build(num_nodes=8, num_gangs=6, tasks_per_gang=2, **kw) -> Cluster:
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=num_nodes, num_gangs=num_gangs,
+        tasks_per_gang=tasks_per_gang, **kw)
+    return Cluster.from_objects(nodes, queues, groups, pods, topo)
+
+
+def refresh(snap, cluster):
+    return snap.refresh(cluster, now=cluster.now)
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_cursor_consume_resets(self):
+        j = MutationJournal()
+        cur = j.register()
+        j.mark_pod("a")
+        j.mark_pod_added("b")
+        j.mark_gang("g")
+        j.mark_time()
+        got = cur.consume()
+        assert got.pods_dirty == {"a"}
+        assert got.pods_added == ["b"]
+        assert got.gangs_dirty == {"g"}
+        assert got.time_dirty
+        empty = cur.consume()
+        assert not empty.pods_dirty and not empty.pods_added
+        assert not empty.time_dirty
+
+    def test_multiple_consumers_each_see_all_marks(self):
+        j = MutationJournal()
+        c1, c2 = j.register(), j.register()
+        j.mark_pod("p")
+        assert c1.consume().pods_dirty == {"p"}
+        # c2's view is independent — not drained by c1
+        assert c2.consume().pods_dirty == {"p"}
+
+    def test_cluster_ops_are_journaled(self):
+        cluster = build()
+        cur = cluster.journal.register()
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING)
+        cluster.bind_pod(pod.name, list(cluster.nodes)[0])
+        cluster.evict_pod(pod.name)
+        cluster.tick()
+        got = cur.consume()
+        assert pod.name in got.pods_dirty
+        assert pod.name in got.pods_removed  # reaped by the tick
+        assert got.time_dirty
+
+    def test_submit_appends(self):
+        cluster = build()
+        cur = cluster.journal.register()
+        g = apis.PodGroup(name="new-gang", queue="queue-0-0",
+                          min_member=1)
+        cluster.submit(g, [apis.Pod(name="new-pod", group="new-gang")])
+        got = cur.consume()
+        assert got.gangs_added == ["new-gang"]
+        assert got.pods_added == ["new-pod"]
+
+
+# ---------------------------------------------------------------------------
+# Patch equivalence (verify=True asserts bit-identity internally)
+# ---------------------------------------------------------------------------
+
+
+class TestPatchEquivalence:
+    def test_bind_evict_submit_cycle_patches_identically(self):
+        cluster = build(num_nodes=8, num_gangs=6, tasks_per_gang=2)
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        # bind two pods, evict one, submit a new gang, tick — patched
+        pend = [p for p in cluster.pods.values()
+                if p.status == apis.PodStatus.PENDING]
+        cluster.bind_pod(pend[0].name, "node-0")
+        cluster.bind_pod(pend[1].name, "node-1")
+        refresh(snap, cluster)
+        cluster.evict_pod(pend[0].name)
+        cluster.tick()
+        refresh(snap, cluster)
+        g = apis.PodGroup(name="late", queue="queue-0-0", min_member=1)
+        cluster.submit(g, [apis.Pod(
+            name="late-0", group="late",
+            resources=apis.ResourceVec(1, 1, 4))])
+        refresh(snap, cluster)
+        assert snap.stats.patched == 3
+        assert snap.stats.full_builds == 1  # the cold build only
+
+    def test_direct_status_mutation_is_swept_and_patched(self):
+        """Un-journaled in-place writes (tests/controllers do this) are
+        detected by the drift sweep and patched correctly."""
+        cluster = build(num_gangs=4, running_fraction=0.5)
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.RUNNING)
+        pod.status = apis.PodStatus.SUCCEEDED  # direct, no journal
+        refresh(snap, cluster)
+        assert snap.stats.patched == 1
+
+    def test_randomized_churn_property(self):
+        """Randomized bind/evict/submit/delete/tick streams over many
+        cycles: every patched snapshot must equal a fresh full rebuild
+        (asserted by verify=True), including forced-fallback cycles."""
+        rng = np.random.default_rng(42)
+        cluster = build(num_nodes=8, num_gangs=8, tasks_per_gang=2,
+                        running_fraction=0.25,
+                        topology_levels=(2, 2))
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        submitted = 0
+        for cycle in range(12):
+            for _ in range(int(rng.integers(1, 4))):
+                op = rng.choice(["bind", "evict", "submit", "tick",
+                                 "mutate"])
+                pods = list(cluster.pods.values())
+                if op == "bind":
+                    pend = [p for p in pods
+                            if p.status == apis.PodStatus.PENDING]
+                    if pend:
+                        p = pend[int(rng.integers(len(pend)))]
+                        node = f"node-{rng.integers(8)}"
+                        try:
+                            cluster.bind_pod(p.name, node)
+                        except RuntimeError:
+                            pass
+                elif op == "evict":
+                    run = [p for p in pods if p.status in
+                           (apis.PodStatus.BOUND, apis.PodStatus.RUNNING)]
+                    if run:
+                        cluster.evict_pod(
+                            run[int(rng.integers(len(run)))].name)
+                elif op == "submit":
+                    submitted += 1
+                    name = f"extra-{submitted}"
+                    g = apis.PodGroup(name=name, queue="queue-0-0",
+                                      min_member=1)
+                    cluster.submit(g, [apis.Pod(
+                        name=f"{name}-p{i}", group=name,
+                        resources=apis.ResourceVec(1, 1, 4))
+                        for i in range(int(rng.integers(1, 3)))])
+                elif op == "tick":
+                    cluster.tick()
+                else:
+                    run = [p for p in pods if p.status
+                           == apis.PodStatus.RUNNING]
+                    if run:
+                        run[int(rng.integers(len(run)))].status = \
+                            apis.PodStatus.SUCCEEDED
+            refresh(snap, cluster)
+        # the stream must exercise the patch path, not just fall back
+        assert snap.stats.patched >= 8, snap.stats
+
+    def test_patch_through_binder_devices(self):
+        """Binder-bound pods carry concrete accel devices — the
+        recorded-device occupancy path must patch identically."""
+        cluster = build(num_nodes=4, num_gangs=4, tasks_per_gang=2)
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        sched = Scheduler(SchedulerConfig(incremental=False))
+        binder = Binder()
+        refresh(snap, cluster)
+        sched.run_once(cluster)
+        binder.reconcile(cluster)
+        refresh(snap, cluster)
+        cluster.tick()
+        refresh(snap, cluster)
+        assert snap.stats.patched == 2
+
+    def test_shapes_stay_pinned_across_churn(self):
+        """Capacity floors keep every compiled shape identical across
+        patched cycles (shape changes would recompile the kernels)."""
+        cluster = build(num_nodes=8, num_gangs=6, tasks_per_gang=2)
+        snap = IncrementalSnapshotter(dirty_threshold=1.0)
+        state0, _ = refresh(snap, cluster)
+        shapes0 = [leaf.shape for leaf in
+                   __import__("jax").tree_util.tree_leaves(state0)]
+        pend = [p.name for p in cluster.pods.values()
+                if p.status == apis.PodStatus.PENDING]
+        for i, name in enumerate(pend[:4]):
+            cluster.bind_pod(name, f"node-{i % 8}")
+        cluster.tick()
+        state1, _ = refresh(snap, cluster)
+        shapes1 = [leaf.shape for leaf in
+                   __import__("jax").tree_util.tree_leaves(state1)]
+        assert shapes0 == shapes1
+        assert snap.stats.patched == 1
+
+    def test_unchanged_leaves_reuse_device_buffers(self):
+        cluster = build(num_nodes=8, num_gangs=6, tasks_per_gang=2)
+        snap = IncrementalSnapshotter(dirty_threshold=1.0)
+        state0, _ = refresh(snap, cluster)
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING)
+        cluster.bind_pod(pod.name, "node-0")
+        state1, _ = refresh(snap, cluster)
+        # node labels/topology never changed — same device buffer
+        assert state1.nodes.labels is state0.nodes.labels
+        assert state1.nodes.topology is state0.nodes.topology
+        assert state1.nodes.allocatable is state0.nodes.allocatable
+        # the running table did change
+        assert state1.running.valid is not state0.running.valid
+
+
+# ---------------------------------------------------------------------------
+# Fallback triggers
+# ---------------------------------------------------------------------------
+
+
+class TestFallbacks:
+    def test_structural_node_change_falls_back(self):
+        cluster = build()
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        cluster.nodes["node-extra"] = apis.Node(
+            name="node-extra",
+            allocatable=apis.ResourceVec(8, 64, 256))
+        refresh(snap, cluster)
+        assert snap.stats.patched == 0
+        assert "node-membership-drift" in snap.stats.fallbacks
+
+    def test_queue_set_change_falls_back(self):
+        cluster = build()
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        cluster.queues["q-late"] = apis.Queue(name="q-late",
+                                              parent="dept-0")
+        refresh(snap, cluster)
+        assert snap.stats.patched == 0
+        assert "queue-set-changed" in snap.stats.fallbacks
+
+    def test_feature_pod_falls_back(self):
+        """Fractional-share pods ride the irregular intake paths — the
+        snapshotter must fall back, not mis-patch."""
+        cluster = build()
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        g = apis.PodGroup(name="frac-gang", queue="queue-0-0",
+                          min_member=1)
+        cluster.submit(g, [apis.Pod(
+            name="frac-pod", group="frac-gang", accel_portion=0.5,
+            resources=apis.ResourceVec(0, 1, 1))])
+        refresh(snap, cluster)
+        assert "nonplain-pods" in snap.stats.fallbacks
+        # once the feature pod leaves, patching resumes
+        cluster.evict_pod("frac-pod")
+        cluster.tick()
+        refresh(snap, cluster)  # full (ledger had the nonplain pod)
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING)
+        cluster.bind_pod(pod.name, "node-0")
+        refresh(snap, cluster)
+        assert snap.stats.patched >= 1
+
+    def test_dirty_threshold_falls_back(self):
+        cluster = build()
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=0.0)
+        refresh(snap, cluster)
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING)
+        cluster.bind_pod(pod.name, "node-0")
+        refresh(snap, cluster)
+        assert snap.stats.patched == 0
+        assert "dirty-threshold" in snap.stats.fallbacks
+
+    def test_topology_swap_falls_back(self):
+        cluster = build(topology_levels=(2, 2))
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        cluster.topology = dataclasses.replace(cluster.topology)
+        refresh(snap, cluster)
+        assert snap.stats.patched == 0
+        assert "topology-changed" in snap.stats.fallbacks
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration (the verify_incremental flag end-to-end)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerIntegration:
+    def test_multi_cycle_e2e_with_verify_incremental(self):
+        """Scheduler + binder over several cycles with
+        ``verify_incremental`` on: every patched cycle is asserted
+        identical to a fresh rebuild, and scheduling results flow."""
+        cluster = build(num_nodes=4, node_accel=8.0, num_gangs=4,
+                        tasks_per_gang=2)
+        cfg = SchedulerConfig(verify_incremental=True,
+                              incremental_dirty_threshold=1.0)
+        sched, binder = Scheduler(cfg), Binder()
+        r1 = sched.run_once(cluster)
+        assert len(r1.bind_requests) == 8
+        assert len(binder.reconcile(cluster).bound) == 8
+        cluster.tick()
+        r2 = sched.run_once(cluster)
+        assert r2.bind_requests == []
+        # drain one gang and let the next cycle re-place capacity
+        for p in list(cluster.pods.values())[:2]:
+            p.status = apis.PodStatus.SUCCEEDED
+        cluster.tick()
+        g = apis.PodGroup(name="late", queue="queue-0-0", min_member=2)
+        cluster.submit(g, [apis.Pod(
+            name=f"late-{i}", group="late",
+            resources=apis.ResourceVec(1, 1, 4)) for i in range(2)])
+        r3 = sched.run_once(cluster)
+        assert len(r3.bind_requests) == 2
+        snap = sched._snapshotter
+        assert snap is not None and snap.verify
+        assert snap.stats.patched >= 1, snap.stats
+
+    def test_incremental_off_uses_plain_session_open(self):
+        cluster = build(num_nodes=4, num_gangs=2)
+        sched = Scheduler(SchedulerConfig(incremental=False))
+        r = sched.run_once(cluster)
+        assert sched._snapshotter is None
+        assert len(r.bind_requests) == 4
+
+    def test_sharded_scheduler_bypasses_incremental(self):
+        shard = apis.SchedulingShard(name="s0",
+                                     partition_label_value=None)
+        cluster = build(num_nodes=4, num_gangs=2)
+        sched = Scheduler(SchedulerConfig(shard=shard))
+        sched.run_once(cluster)
+        assert sched._snapshotter is None
+
+
+class TestBindRequestPresentation:
+    def test_direct_bind_request_clear_is_swept(self):
+        """A Pending BindRequest presents its pod as bound; clearing the
+        store directly (no journal) must still flip the presentation
+        back — the sweep covers the BR table too."""
+        cluster = build(num_nodes=4, num_gangs=4, tasks_per_gang=2)
+        snap = IncrementalSnapshotter(verify=True, dirty_threshold=1.0)
+        refresh(snap, cluster)
+        pod = next(p for p in cluster.pods.values()
+                   if p.status == apis.PodStatus.PENDING)
+        cluster.create_bind_request(apis.BindRequest(
+            pod_name=pod.name, selected_node="node-0"))
+        state, _ = refresh(snap, cluster)
+        assert int(np.asarray(state.running.valid).sum()) == 1
+        cluster.bind_requests.clear()  # direct, unjournaled
+        state, _ = refresh(snap, cluster)
+        assert int(np.asarray(state.running.valid).sum()) == 0
+        assert snap.stats.patched == 2

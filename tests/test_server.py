@@ -81,6 +81,36 @@ class TestContinuousProfiler:
                     if "busy_beacon" in ln)
         assert line.rsplit(" ", 1)[1].isdigit()
 
+    def test_stop_start_cycle_resumes_sampling(self):
+        """start() must clear the stop event a previous stop() left set,
+        or the re-started sampler thread exits immediately and
+        profiling silently stops (ADVICE r5)."""
+        import threading
+        import time as _t
+
+        from kai_scheduler_tpu.runtime.profiling import ContinuousProfiler
+
+        stop = threading.Event()
+
+        def busy_beacon():
+            while not stop.is_set():
+                _t.sleep(0.001)
+
+        t = threading.Thread(target=busy_beacon, daemon=True)
+        t.start()
+        prof = ContinuousProfiler(sample_hz=200, window_s=10.0).start()
+        _t.sleep(0.2)
+        prof.stop()
+        assert prof._thread is None and prof._stop.is_set()
+        prof.start()   # restart: must clear the event and sample again
+        _t.sleep(0.3)
+        assert prof._thread is not None and prof._thread.is_alive()
+        prof.stop()
+        stop.set()
+        t.join(timeout=1)
+        # the post-restart window saw the beacon thread
+        assert "busy_beacon" in prof.render_folded(prof.windows[-1][2])
+
     def test_push_hits_ingest_endpoint(self):
         import http.server
         import threading
