@@ -23,6 +23,7 @@ import argparse
 import dataclasses
 import json
 import sys
+import time
 
 
 def _build_config(args) -> "SchedulerConfig":
@@ -109,7 +110,6 @@ def main(argv: list[str] | None = None) -> int:
     server = SchedulerServer(cluster, scheduler, port=args.port).start()
     print(f"serving on 127.0.0.1:{server.port}", file=sys.stderr)
     try:
-        import time
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:

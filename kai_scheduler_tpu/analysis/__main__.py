@@ -1,0 +1,119 @@
+"""``python -m kai_scheduler_tpu.analysis`` — the kai-lint CLI.
+
+Default run: layer-1 AST lint over the package plus the layer-2 jaxpr
+probe.  Exit status is nonzero on any non-baselined finding, so the
+command doubles as the CI gate (``scripts/lint.py`` wraps the
+lint-only fast path for pre-commit).
+
+    python -m kai_scheduler_tpu.analysis              # lint + probe
+    python -m kai_scheduler_tpu.analysis --no-probe   # AST lint only
+    python -m kai_scheduler_tpu.analysis --json       # machine output
+    python -m kai_scheduler_tpu.analysis --list-rules
+    python -m kai_scheduler_tpu.analysis --probe --update-baseline
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kai_scheduler_tpu.analysis",
+        description="kai-lint: trace-safety, determinism, and "
+                    "recompile-hazard analysis for the TPU hot path")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the package's parent)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated KAI codes to run (lint)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON for the lint layer (default: "
+                         "the package baseline.json)")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--no-probe", action="store_true",
+                      help="skip the jaxpr probe (AST lint only)")
+    mode.add_argument("--probe", action="store_true",
+                      help="jaxpr probe only (skip the AST lint)")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated op names for the probe")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the probe stats in baseline.json")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .engine import lint_package, load_baseline, rule_catalog
+    if args.list_rules:
+        for code, title in rule_catalog().items():
+            print(f"{code}  {title}")
+        return 0
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    root = args.root or os.path.dirname(os.path.dirname(pkg_dir))
+    baseline_path = args.baseline or os.path.join(pkg_dir,
+                                                  "baseline.json")
+    out: dict = {"findings": [], "probe": []}
+    failed = False
+
+    if not args.probe:
+        baseline = (load_baseline(baseline_path)
+                    if os.path.exists(baseline_path) else [])
+        select = (args.select.split(",") if args.select else None)
+        res = lint_package(root, select=select, baseline=baseline)
+        out["findings"] = [f.__dict__ for f in res.findings]
+        out["baselined"] = res.baselined
+        if not args.as_json:
+            for f in res.findings:
+                print(f.render())
+            n = len(res.findings)
+            print(f"kai-lint: {n} finding{'s' * (n != 1)} "
+                  f"({res.raw_count} raw, {res.baselined} baselined, "
+                  f"{len(res.stale_suppressions)} stale suppressions)")
+        failed |= bool(res.findings)
+
+    if not args.no_probe:
+        from .trace_probe import (check_against_baseline,
+                                  check_invariants, load_stats_baseline,
+                                  run_probe, update_baseline)
+        reports = run_probe(args.ops.split(",") if args.ops else None)
+        if args.update_baseline:
+            # the baseline only absorbs eqn/const stats; callbacks,
+            # f64, and cache misses have no legitimate new value and
+            # still fail (and block the rewrite) here
+            problems = check_invariants(reports)
+            if problems:
+                if not args.as_json:
+                    print("probe baseline NOT updated — invariant "
+                          "failures first:")
+            else:
+                update_baseline(reports, baseline_path)
+                if not args.as_json:
+                    print(f"probe baseline updated: {baseline_path}")
+        else:
+            stats = (load_stats_baseline(baseline_path)
+                     if os.path.exists(baseline_path) else {})
+            problems = check_against_baseline(
+                reports, stats, full_coverage=not args.ops)
+        out["probe"] = [r.__dict__ for r in reports]
+        out["probe_problems"] = problems
+        if not args.as_json:
+            for r in reports:
+                hit = {True: "cache-hit", False: "CACHE-MISS",
+                       None: "cache-n/a"}[r.cache_hit]
+                print(f"probe {r.name}: {r.eqns} eqns, "
+                      f"{r.const_bytes}B consts, {hit}")
+            for p in problems:
+                print(f"PROBE FAIL: {p}")
+        failed |= bool(problems)
+
+    if args.as_json:
+        json.dump(out, sys.stdout, indent=2, default=str)
+        print()
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

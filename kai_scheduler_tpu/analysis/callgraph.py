@@ -1,0 +1,242 @@
+"""Jit-region call graph — which functions run *inside* a compiled op.
+
+Most KAI rules only make sense inside a jit trace: ``np.asarray`` in
+the CLI is fine, in ``ops/allocate.py`` it is a host sync.  Rather than
+hand-maintain a module list, the region is grown from the actual
+``jax.jit`` entry points:
+
+* ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorated defs;
+* module-level ``f_jit = jax.jit(f)`` and
+  ``f_jit = functools.partial(jax.jit, ...)(f)`` wrappers (the
+  ``allocate_jit`` / ``stale_eviction_jit`` idiom).
+
+From those entries the graph follows direct calls (``name(...)``),
+module-attribute calls (``drf.set_fair_share(...)``) and one level of
+package ``__init__`` re-export (``from ..plugins import compose``).
+Method calls on values (``result.replace(...)``) are not resolved —
+pytree ``replace`` bodies are generated field shuffles, and anything
+substantive in this codebase is a module-level function.
+
+Resolution is best-effort by design: a missed edge only narrows the
+checked region (a rule stays silent), never breaks the build, and the
+trace probe (layer 2) still sees the full program at the jaxpr level.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator
+
+#: relative source files never worth parsing (generated protobuf)
+GENERATED = ("_pb2.py",)
+
+
+def _iter_functions(tree: ast.Module) -> Iterator[tuple[str, ast.AST]]:
+    """Yield (qualname, node) for every def in the module, including
+    methods (``Class.method``) and nested defs (``outer.inner``)."""
+    def walk(body, prefix):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = prefix + node.name
+                yield q, node
+                yield from walk(node.body, q + ".")
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, prefix + node.name + ".")
+    yield from walk(tree.body, "")
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed source file of the package."""
+
+    relpath: str            # posix path relative to the repo root
+    modname: str            # dotted module name (kai_scheduler_tpu.x.y)
+    tree: ast.Module
+    source: str
+    #: qualname -> def node (methods as Class.method, nested as a.b)
+    functions: dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    #: local alias -> dotted module it names (import table, whole file)
+    mod_aliases: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: local name -> (dotted module, original name) for from-imports
+    sym_imports: dict[str, tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        self.functions = dict(_iter_functions(self.tree))
+        pkg = self.modname.rsplit(".", 1)[0] if "." in self.modname else ""
+        if self.modname.endswith("__init__"):
+            pkg = self.modname[: -len(".__init__")]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.mod_aliases[a.asname] = a.name
+                    else:
+                        # `import jax.numpy` binds the ROOT name only
+                        root = a.name.split(".")[0]
+                        self.mod_aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = _resolve_from(pkg, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.sym_imports[a.asname or a.name] = (base, a.name)
+
+    def alias_root(self, name: str) -> str | None:
+        """Dotted module a bare name refers to (``np`` -> ``numpy``,
+        ``jnp`` -> ``jax.numpy``, ``lax`` -> ``jax.lax``) or None."""
+        if name in self.mod_aliases:
+            return self.mod_aliases[name]
+        if name in self.sym_imports:
+            mod, orig = self.sym_imports[name]
+            return f"{mod}.{orig}"
+        return None
+
+
+def _resolve_from(pkg: str, node: ast.ImportFrom) -> str | None:
+    """Absolute dotted module a ``from X import ...`` targets."""
+    if node.level == 0:
+        return node.module
+    parts = pkg.split(".") if pkg else []
+    up = node.level - 1
+    if up > len(parts):
+        return None
+    base = parts[: len(parts) - up]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base) if base else None
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``jax.jit`` / ``functools.partial`` attribute chain as a string."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class PackageGraph:
+    """AST index + jit entry points + reachable jit region."""
+
+    def __init__(self, root: str, package: str = "kai_scheduler_tpu"):
+        self.root = root
+        self.package = package
+        self.modules: dict[str, ModuleInfo] = {}      # modname -> info
+        pkg_dir = os.path.join(root, package.replace(".", os.sep))
+        for dirpath, _dirnames, filenames in os.walk(pkg_dir):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py") or fn.endswith(GENERATED):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                modname = rel[:-3].replace("/", ".")
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                self.modules[modname] = ModuleInfo(
+                    relpath=rel, modname=modname,
+                    tree=ast.parse(src, filename=rel), source=src)
+        #: (modname, qualname) of every function inside the jit region
+        self.jit_region: set[tuple[str, str]] = set()
+        self._grow()
+
+    # -- entry detection --------------------------------------------------
+
+    def _is_jit_expr(self, mod: ModuleInfo, node: ast.AST) -> bool:
+        """True for expressions evaluating to a jit transform:
+        ``jax.jit``, ``functools.partial(jax.jit, ...)``."""
+        d = _dotted(node)
+        if d is not None:
+            root = mod.alias_root(d.split(".")[0]) or d.split(".")[0]
+            full = ".".join([root] + d.split(".")[1:])
+            if full in ("jax.jit", "jax.api.jit"):
+                return True
+        if isinstance(node, ast.Call):
+            f = _dotted(node.func)
+            if f is not None:
+                root = mod.alias_root(f.split(".")[0]) or f.split(".")[0]
+                full = ".".join([root] + f.split(".")[1:])
+                if full.endswith("partial") and node.args \
+                        and self._is_jit_expr(mod, node.args[0]):
+                    return True
+        return False
+
+    def _entries(self) -> Iterator[tuple[ModuleInfo, str]]:
+        for mod in self.modules.values():
+            for qual, fn in mod.functions.items():
+                for deco in getattr(fn, "decorator_list", []):
+                    if self._is_jit_expr(mod, deco):
+                        yield mod, qual
+            for node in ast.walk(mod.tree):
+                # f_jit = jax.jit(f) / functools.partial(jax.jit, ..)(f)
+                if not (isinstance(node, ast.Call) and node.args
+                        and self._is_jit_expr(mod, node.func)):
+                    continue
+                target = node.args[0]
+                resolved = self._resolve_call(mod, target)
+                if resolved is not None:
+                    yield self.modules[resolved[0]], resolved[1]
+
+    # -- call resolution --------------------------------------------------
+
+    def _lookup(self, modname: str, name: str,
+                depth: int = 0) -> tuple[str, str] | None:
+        """Find function ``name`` in module ``modname``, following one
+        level of ``__init__`` re-export."""
+        mod = self.modules.get(modname) \
+            or self.modules.get(modname + ".__init__")
+        if mod is None or depth > 2:
+            return None
+        if name in mod.functions:
+            return mod.modname, name
+        if name in mod.sym_imports:
+            src_mod, orig = mod.sym_imports[name]
+            return self._lookup(src_mod, orig, depth + 1)
+        return None
+
+    def _resolve_call(self, mod: ModuleInfo,
+                      func: ast.AST) -> tuple[str, str] | None:
+        if isinstance(func, ast.Name):
+            if func.id in mod.functions:
+                return mod.modname, func.id
+            if func.id in mod.sym_imports:
+                src_mod, orig = mod.sym_imports[func.id]
+                return self._lookup(src_mod, orig)
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            target_mod = mod.alias_root(func.value.id)
+            if target_mod is not None:
+                return self._lookup(target_mod, func.attr)
+        return None
+
+    # -- region growth ----------------------------------------------------
+
+    def _grow(self) -> None:
+        work = list(dict.fromkeys(
+            (m.modname, q) for m, q in self._entries()))
+        seen = set(work)
+        while work:
+            modname, qual = work.pop()
+            self.jit_region.add((modname, qual))
+            mod = self.modules[modname]
+            fn = mod.functions.get(qual)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = self._resolve_call(mod, node.func)
+                if resolved is not None and resolved not in seen:
+                    seen.add(resolved)
+                    work.append(resolved)
+
+    def jit_functions(self, modname: str) -> set[str]:
+        """Qualnames of this module's functions inside the jit region."""
+        return {q for m, q in self.jit_region if m == modname}

@@ -28,7 +28,9 @@ DEFAULT_ACTIONS = "allocate, consolidation, reclaim, preempt, stalegangeviction"
 
 def parse_document(text: str) -> dict:
     """Parse a YAML (or JSON — a YAML subset) config document."""
-    import yaml
+    # lazy on purpose: PyYAML is optional — JSON-only deployments (and
+    # the sidecar wire path) never pay or require the dependency
+    import yaml  # kai-lint: disable=KAI052
     doc = yaml.safe_load(text)
     if doc is None:
         return {}

@@ -15,11 +15,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import weakref
 from typing import Callable, Protocol
 
 import functools
 
 import jax
+import numpy as np
 
 from ..apis import types as apis
 from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
@@ -344,14 +346,12 @@ class Scheduler:
             # be proven identical — see state/incremental.py
             if (self._snapshotter_cluster is None
                     or self._snapshotter_cluster() is not cluster):
-                import weakref as _weakref
-
                 from ..state.incremental import IncrementalSnapshotter
                 self._snapshotter = IncrementalSnapshotter(
                     verify=self.config.verify_incremental,
                     dirty_threshold=self.config
                     .incremental_dirty_threshold)
-                self._snapshotter_cluster = _weakref.ref(cluster)
+                self._snapshotter_cluster = weakref.ref(cluster)
             state, index = self._snapshotter.refresh(
                 cluster, now=cluster.now, queue_usage=queue_usage)
             session = Session.from_state(state, index,
@@ -432,7 +432,6 @@ class Scheduler:
         # detection is VECTORIZED against the previous cycle's tables so
         # the Python loop touches only cells that moved — O(changed)
         # rather than 3·Q·R dict probes per cycle (round-3 advisor)
-        import numpy as np
         fs = host["fair_share"]
         alloc = host["queue_allocated"]
         usage = host["queue_usage"]
@@ -468,7 +467,6 @@ class Scheduler:
         ``scheduling_backoff`` consecutive failed cycles the group is
         marked unschedulable and the snapshot skips it until pod churn
         clears the condition (podgroup controller)."""
-        import numpy as np
         allocated = host["allocated"]
         explanations = session.unschedulable_explanations(
             result.tensors, host=host)
@@ -489,7 +487,6 @@ class Scheduler:
             else:
                 self.status_updater.enqueue(key, fn)
 
-        import weakref
         if (self._fit_shadow_cluster is None
                 or self._fit_shadow_cluster() is not cluster):
             self._fit_shadow.clear()

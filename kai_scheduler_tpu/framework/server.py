@@ -21,7 +21,10 @@ stable JSON documents of ``runtime/snapshot.py``.
 """
 from __future__ import annotations
 
+import copy
+import cProfile
 import json
+import pstats
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -63,10 +66,6 @@ def profile_cycle(cluster: Cluster, scheduler: Scheduler,
     ``/debug/pprof/profile`` analogue (ref ``cmd/scheduler/profiling``):
     returns the hottest host-side functions plus the cycle's phase
     timings (device time shows up as the blocking transfer)."""
-    import copy
-    import cProfile
-    import pstats
-
     # profile against a private copy: a profiling GET must never write
     # bind requests or evictions into the server's stored cluster
     cluster = copy.deepcopy(cluster)

@@ -195,8 +195,10 @@ class Session:
         g, q, r = self.state.gangs, self.state.queues, self.state.running
         G, T, M, Q = g.g, g.t, r.m, q.q
         R_ = self.state.nodes.free.shape[1]
-        assert self.state.nodes.n + 1 < 2**15, \
-            "i16 commit packing needs < 32k nodes"
+        if self.state.nodes.n + 1 >= 2**15:
+            # survives `python -O`: silently wrapped i16 node indices
+            # would bind pods to the wrong nodes
+            raise ValueError("i16 commit packing needs < 32k nodes")
         devices = self.index.needs_device_table
         flat = np.asarray(_pack_commit(result, self.state,
                                        track_devices=devices))

@@ -166,7 +166,8 @@ def anti_forbid_nodes(state: ClusterState, anti_used: jax.Array,
     g = state.gangs
     L = state.nodes.topology.shape[1]
     TA = g.anti_term_level.shape[0]
-    assert TA > 0, "anti kernels compiled without terms"
+    if TA <= 0:
+        raise ValueError("anti kernels compiled without terms")
     avoids = g.anti_avoids[jnp.maximum(gang_idx, 0)]       # [..., KT]
     t_safe = jnp.clip(avoids, 0, TA - 1)
     lvl = g.anti_term_level[t_safe]
@@ -184,7 +185,8 @@ def anti_mark_placements(state: ClusterState, anti_used: jax.Array,
     g, n = state.gangs, state.nodes
     L = n.topology.shape[1]
     TA = g.anti_term_level.shape[0]
-    assert TA > 0, "anti kernels compiled without terms"
+    if TA <= 0:
+        raise ValueError("anti kernels compiled without terms")
     AD = n.n * L + n.n
     marks = g.anti_marks[jnp.maximum(gang_idx, 0)]         # [..., KT]
     t_safe = jnp.clip(marks, 0, TA - 1)
@@ -230,7 +232,8 @@ def attract_allow_nodes(state: ClusterState, anti_used: jax.Array,
     g = state.gangs
     L = state.nodes.topology.shape[1]
     TA = g.anti_term_level.shape[0]
-    assert TA > 0, "attract kernels compiled without terms"
+    if TA <= 0:
+        raise ValueError("attract kernels compiled without terms")
     needs = g.attract_needs[jnp.maximum(gang_idx, 0)]      # [..., KP]
     t_safe = jnp.clip(needs, 0, TA - 1)
     lvl = g.anti_term_level[t_safe]
@@ -1153,8 +1156,9 @@ def _attempt_gang(state: ClusterState, gang_idx: jax.Array,
     pref_doms = n.topology[:, jnp.maximum(pl, 0)]              # [N]
 
     if config.uniform_tasks:
-        assert not config.track_devices, \
-            "uniform_tasks fast path requires track_devices=False"
+        if config.track_devices:
+            raise ValueError(
+                "uniform_tasks fast path requires track_devices=False")
         in_domain = _attempt_gang_in_domain_uniform
     else:
         in_domain = _attempt_gang_in_domain

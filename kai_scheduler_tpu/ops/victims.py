@@ -1309,7 +1309,8 @@ def run_victim_action(
     resources the same way); consolidation victims additionally get a
     planned re-placement node in ``victim_move``.
     """
-    assert mode in ("reclaim", "preempt", "consolidate"), mode
+    if mode not in ("reclaim", "preempt", "consolidate"):
+        raise ValueError(f"unknown victim action mode: {mode!r}")
     g, q, r = state.gangs, state.queues, state.running
     G = g.g
     total = state.total_capacity

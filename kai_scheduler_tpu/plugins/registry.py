@@ -14,6 +14,7 @@ import dataclasses
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 
 
 @dataclasses.dataclass
@@ -70,7 +71,6 @@ def parse_tiers(spec: str) -> tuple[str, ...]:
 
 def compose(ctx: ScoreContext, names: tuple[str, ...]) -> jax.Array:
     """Sum the selected plugins' bands — [N] f32 (no feasibility mask)."""
-    import jax.numpy as jnp
     total = jnp.zeros_like(ctx.fit_pipe, dtype=jnp.float32)
     for fn in resolve(names):
         total = total + fn(ctx)

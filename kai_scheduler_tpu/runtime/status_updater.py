@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Callable
 
 #: ref NumOfStatusRecordingWorkers (cache/cache.go), default 5
@@ -102,7 +103,6 @@ class AsyncStatusUpdater:
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Wait for the queue AND in-flight applies to drain."""
-        import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:

@@ -25,6 +25,7 @@ urllib against ``address``.
 from __future__ import annotations
 
 import dataclasses
+import datetime as dt
 import json
 import urllib.parse
 import urllib.request
@@ -88,7 +89,6 @@ def latest_cron_reset(expr: str, now_s: float) -> float:
     (minute hour day-of-month month day-of-week; ``*`` or integers) —
     the tumbling window's reset anchor (ref cronWindowExpression).
     Epoch seconds in UTC."""
-    import datetime as dt
     fields = expr.split()
     if len(fields) != 5:
         raise ValueError(f"cron expression needs 5 fields: {expr!r}")

@@ -1128,7 +1128,10 @@ def build_snapshot(
                 i = gidx[j]
                 ports_by_gang.setdefault(i, set()).update(p.host_ports)
                 cnts = port_counts.setdefault(i, {})
-                for prt in set(p.host_ports):
+                # sorted: set order is hash-seed dependent, and these
+                # counts feed the gang-kernel tables — two builds of the
+                # same cluster must stay bit-identical (kai-lint KAI041)
+                for prt in sorted(set(p.host_ports)):
                     cnts[prt] = cnts.get(prt, 0) + 1
         for i, cnts in port_counts.items():
             # replicas SHARING a port can never share a node; a gang

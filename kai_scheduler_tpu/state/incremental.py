@@ -785,7 +785,10 @@ class IncrementalSnapshotter:
                         or c[2] != br.selected_node):
                     br_dirty.append(name)
             if len(br_cache) != len(brs) or br_dirty:
-                for name in br_cache.keys() - brs.keys():
+                # sorted: the set difference iterates in hash order,
+                # which would make the dirty-row encode order (and any
+                # tie-broken downstream buffer) run-dependent (KAI041)
+                for name in sorted(br_cache.keys() - brs.keys()):
                     br_dirty.append(name)
                 self._br_cache = {
                     name: (br, br.phase, br.selected_node)

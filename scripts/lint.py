@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Thin kai-lint wrapper for local / pre-commit use.
+
+Runs the AST layer only (no jax import — sub-second), exits nonzero on
+any new finding:
+
+    python scripts/lint.py             # lint the repo
+    python scripts/lint.py --json      # machine-readable
+    python scripts/lint.py --select KAI041,KAI052
+
+Hook it up with::
+
+    printf 'python scripts/lint.py || exit 1\n' >> .git/hooks/pre-commit
+
+The full gate (AST lint + jaxpr probe) is
+``python -m kai_scheduler_tpu.analysis``; the tier-1 suite runs it via
+``tests/test_analysis.py``.
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from kai_scheduler_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--no-probe", "--root", REPO_ROOT, *sys.argv[1:]]))

@@ -1,0 +1,240 @@
+"""kai-lint tests — rule self-tests, package cleanliness, jaxpr probe.
+
+Three layers of guarantees:
+
+1. **Rule fixtures** — every registered KAI rule carries a must-trigger
+   and a must-not-trigger snippet; both are exercised, so a rule edit
+   that stops detecting its own hazard (or starts flagging the clean
+   idiom) fails here, not in production review.
+2. **Package invariants** — the whole package lints clean with NO
+   baseline, every inline ``kai-lint: disable`` still matches a live
+   finding (no suppression rot), and the shipped lint baseline is
+   empty (the tree owes nothing).
+3. **Trace probe** — every registered op (cross-checked against the
+   call graph's jit entry points, so a new jitted kernel cannot dodge
+   coverage) traces without host callbacks or f64, compiles exactly
+   once per shape bucket across two independent snapshot builds, and
+   stays within the eqn/const budgets of ``analysis/baseline.json``.
+"""
+import json
+import os
+
+import pytest
+
+from kai_scheduler_tpu.analysis import lint_package, lint_source
+from kai_scheduler_tpu.analysis.callgraph import PackageGraph
+from kai_scheduler_tpu.analysis.engine import RULES, rule_catalog
+
+pytestmark = pytest.mark.core
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+rule_catalog()  # force rule registration
+
+
+# ---------------------------------------------------------------------------
+# 1. per-rule fixture self-tests
+
+_FIXTURED = sorted(c for c in RULES if RULES[c].fixture_bad)
+
+
+def test_every_rule_has_fixtures():
+    # KAI000 is emitted by the engine's suppression bookkeeping, not a
+    # checker — everything else must ship its own self-test snippets
+    assert _FIXTURED == sorted(c for c in RULES if c != "KAI000")
+
+
+@pytest.mark.parametrize("code", _FIXTURED)
+def test_rule_fixture_triggers(code):
+    findings = lint_source(RULES[code].fixture_bad)
+    assert any(f.code == code for f in findings), (
+        f"{code} must-trigger fixture produced no {code} finding: "
+        f"{findings}")
+
+
+@pytest.mark.parametrize("code", _FIXTURED)
+def test_rule_fixture_negative(code):
+    findings = lint_source(RULES[code].fixture_good)
+    assert not any(f.code == code for f in findings), (
+        f"{code} must-NOT-trigger fixture still fires: "
+        f"{[f.render() for f in findings if f.code == code]}")
+
+
+def test_jit_region_scoping():
+    """Host-only code is exempt from the trace-safety families: the
+    same .item() that is a finding inside @jax.jit is legal outside."""
+    hot = """
+import jax
+
+@jax.jit
+def op(x):
+    return x.item()
+"""
+    cold = """
+def commit(x):
+    return x.item()
+"""
+    assert any(f.code == "KAI001" for f in lint_source(hot))
+    assert not lint_source(cold)
+
+
+def test_jit_region_grows_through_calls():
+    """A helper only *called from* a jitted entry is in the region."""
+    src = """
+import jax
+import numpy as np
+
+def helper(x):
+    return np.asarray(x)
+
+@jax.jit
+def op(x):
+    return helper(x)
+"""
+    findings = lint_source(src)
+    assert any(f.code == "KAI002" and f.function == "helper"
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 2. suppression + baseline mechanics
+
+def test_suppression_silences_finding():
+    src = """
+def f(xs):
+    for x in set(xs):  # kai-lint: disable=KAI041
+        print(x)
+"""
+    assert lint_source(src) == []
+
+
+def test_own_line_suppression_covers_next_line():
+    src = """
+def f(xs):
+    # kai-lint: disable=KAI041
+    for x in set(xs):
+        print(x)
+"""
+    assert lint_source(src) == []
+
+
+def test_stale_suppression_is_a_finding():
+    src = """
+def f(xs):
+    return sorted(xs)  # kai-lint: disable=KAI041
+"""
+    findings = lint_source(src)
+    assert [f.code for f in findings] == ["KAI000"]
+
+
+def test_docstring_disable_examples_are_inert():
+    src = '''
+def f(xs):
+    """Docs showing `# kai-lint: disable=KAI041` syntax."""
+    return sorted(xs)
+'''
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# 3. the package itself
+
+def test_package_lints_clean_without_baseline():
+    res = lint_package(ROOT)
+    assert res.findings == [], "\n".join(
+        f.render() for f in res.findings)
+
+
+def test_no_stale_suppressions_in_package():
+    """Every inline ``kai-lint: disable`` still matches a live finding."""
+    res = lint_package(ROOT)
+    assert res.stale_suppressions == [], "\n".join(
+        f.render() for f in res.stale_suppressions)
+
+
+def test_lint_baseline_stays_empty():
+    """The shipped baseline carries probe stats ONLY — lint findings
+    are fixed or inline-suppressed, never parked."""
+    path = os.path.join(ROOT, "kai_scheduler_tpu", "analysis",
+                        "baseline.json")
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    assert data.get("lint", []) == []
+
+
+def test_known_jit_entry_points_probed():
+    """Every jit entry the call graph detects maps to probe coverage —
+    add a new jitted kernel and this fails until the probe registry
+    (and its baseline) learn about it."""
+    from kai_scheduler_tpu.analysis.trace_probe import registered_ops
+    entry_to_ops = {
+        "_fused_pipeline": {"fused_pipeline"},
+        "_pack_commit": {"pack_commit"},
+        "allocate_jit": {"allocate"},
+        "set_fair_share": {"set_fair_share"},
+        "stale_gang_eviction": {"stale_gang_eviction"},
+        "run_victim_action_jit": {"victims_reclaim", "victims_preempt",
+                                  "victims_consolidate"},
+        "cumsum_ds": {"cumsum_ds"},
+    }
+    graph = PackageGraph(ROOT)
+    entries = {q for _m, q in graph._entries()}
+    ops = set(registered_ops())
+    for q in sorted(entries):
+        assert q in entry_to_ops, (
+            f"new jit entry point `{q}` — register it in "
+            f"analysis/trace_probe.py::_registry and refresh the "
+            f"baseline (--probe --update-baseline)")
+        missing = entry_to_ops[q] - ops
+        assert not missing, f"probe registry lost ops {missing} for {q}"
+
+
+# ---------------------------------------------------------------------------
+# 4. jaxpr probe (compiles the real kernels — shares the suite's
+#    persistent compile cache and padded shapes)
+
+@pytest.fixture(scope="module")
+def probe_reports():
+    from kai_scheduler_tpu.analysis.trace_probe import run_probe
+    return {r.name: r for r in run_probe()}
+
+
+def test_probe_covers_all_registered_ops(probe_reports):
+    from kai_scheduler_tpu.analysis.trace_probe import registered_ops
+    assert sorted(probe_reports) == sorted(registered_ops())
+
+
+def test_probe_no_forbidden_primitives(probe_reports):
+    bad = {n: r.forbidden for n, r in probe_reports.items()
+           if r.forbidden}
+    assert not bad, f"host callbacks inside compiled ops: {bad}"
+
+
+def test_probe_no_f64_on_device(probe_reports):
+    bad = {n: r.f64_avals for n, r in probe_reports.items()
+           if r.f64_avals}
+    assert not bad, f"f64 avals leaked into device programs: {bad}"
+
+
+def test_probe_compiles_once_per_shape_bucket(probe_reports):
+    """Two independent builds of an equivalent cluster (fresh host
+    objects, different wall clock) must share ONE compile per op —
+    the end-to-end nondeterministic-signature guard.  ``is True``, not
+    ``is not False``: if a jax upgrade drops the ``_cache_size`` probe,
+    every report degrades to None and this must fail LOUDLY rather
+    than pass vacuously (re-wire the probe, don't soften the test)."""
+    not_hit = {n: r.cache_hit for n, r in probe_reports.items()
+               if r.cache_hit is not True}
+    assert not not_hit, (
+        f"compile-once check not confirmed for {not_hit} (False = "
+        f"re-trace missed the jit cache: some input shape/dtype/"
+        f"static-config is build-dependent; None = the cache probe "
+        f"is gone)")
+
+
+def test_probe_stats_within_baseline(probe_reports):
+    from kai_scheduler_tpu.analysis.trace_probe import (
+        check_against_baseline, load_stats_baseline)
+    problems = check_against_baseline(list(probe_reports.values()),
+                                      load_stats_baseline())
+    assert not problems, "\n".join(problems)
