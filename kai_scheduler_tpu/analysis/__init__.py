@@ -20,6 +20,12 @@ This package machine-checks those invariants in two layers:
   primitives (callbacks, f64), asserts compile-cache hits on re-trace
   within a shape bucket, and diffs per-op eqn/const-size stats against
   the checked-in ``baseline.json`` so constant bloat fails loudly.
+* **Layer 3 — kai-race** (``concurrency``): thread-root call graphs +
+  guarded-by lock-discipline analysis for the HOST runtime (the
+  status-updater pool, the ThreadingHTTPServer handlers, the profiler
+  sampler, the mutation journal).  ``KAI1xx`` codes, inline
+  ``# kai-race: guarded-by=`` annotations, and the checked-in
+  ``guarded_by.json`` audit map.  Pure AST, part of the lint layer.
 
 CLI: ``python -m kai_scheduler_tpu.analysis`` (see ``__main__``).
 Suppression syntax: ``# kai-lint: disable=KAI001`` (own line → next

@@ -1,12 +1,14 @@
 """``python -m kai_scheduler_tpu.analysis`` — the kai-lint CLI.
 
-Default run: layer-1 AST lint over the package plus the layer-2 jaxpr
+Default run: layer-1 AST lint over the package (the KAI0xx trace-safety
+rules plus the KAI1xx kai-race concurrency pass) and the layer-2 jaxpr
 probe.  Exit status is nonzero on any non-baselined finding, so the
 command doubles as the CI gate (``scripts/lint.py`` wraps the
 lint-only fast path for pre-commit).
 
     python -m kai_scheduler_tpu.analysis              # lint + probe
     python -m kai_scheduler_tpu.analysis --no-probe   # AST lint only
+    python -m kai_scheduler_tpu.analysis --race       # kai-race only
     python -m kai_scheduler_tpu.analysis --json       # machine output
     python -m kai_scheduler_tpu.analysis --list-rules
     python -m kai_scheduler_tpu.analysis --probe --update-baseline
@@ -38,6 +40,9 @@ def main(argv: list[str] | None = None) -> int:
                       help="skip the jaxpr probe (AST lint only)")
     mode.add_argument("--probe", action="store_true",
                       help="jaxpr probe only (skip the AST lint)")
+    mode.add_argument("--race", action="store_true",
+                      help="kai-race concurrency pass only (KAI1xx; "
+                           "jax-free)")
     ap.add_argument("--ops", default=None,
                     help="comma-separated op names for the probe")
     ap.add_argument("--update-baseline", action="store_true",
@@ -62,17 +67,52 @@ def main(argv: list[str] | None = None) -> int:
         baseline = (load_baseline(baseline_path)
                     if os.path.exists(baseline_path) else [])
         select = (args.select.split(",") if args.select else None)
+        if args.race:
+            from .concurrency import race_codes
+            select = list(race_codes()) if select is None else [
+                c for c in select if c in race_codes()]
+            if not select:
+                # --select named no KAI1xx code: running zero rules
+                # would print a FALSE "0 findings" clean bill
+                ap.error("--race with --select requires at least one "
+                         "KAI1xx code")
         res = lint_package(root, select=select, baseline=baseline)
         out["findings"] = [f.__dict__ for f in res.findings]
         out["baselined"] = res.baselined
+        if res.race is not None:
+            # the kai-race layer's report: discovered thread roots and
+            # the KAI1xx slice of the findings (consumed by the CLI
+            # smoke test and any tooling watching the race surface)
+            race_findings = [f.__dict__ for f in res.findings
+                             if f.code.startswith("KAI1")]
+            out["race"] = {
+                "thread_roots": {
+                    r.root_id: {"kind": r.kind, "multi": r.multi}
+                    for r in res.race.roots},
+                "findings": race_findings,
+                "live_annotations": res.race.live_annotations,
+                "declared_attrs": len(res.race.disciplines),
+            }
         if not args.as_json:
             for f in res.findings:
                 print(f.render())
             n = len(res.findings)
+            extra = ""
+            if res.race is not None:
+                extra = (f", {len(res.race.roots)} thread roots, "
+                         f"{res.race.live_annotations} live guarded-by "
+                         f"annotations")
             print(f"kai-lint: {n} finding{'s' * (n != 1)} "
                   f"({res.raw_count} raw, {res.baselined} baselined, "
-                  f"{len(res.stale_suppressions)} stale suppressions)")
+                  f"{len(res.stale_suppressions)} stale suppressions"
+                  f"{extra})")
         failed |= bool(res.findings)
+
+    if args.race:
+        if args.as_json:
+            json.dump(out, sys.stdout, indent=2, default=str)
+            print()
+        return 1 if failed else 0
 
     if not args.no_probe:
         from .trace_probe import (check_against_baseline,

@@ -1,0 +1,1520 @@
+"""kai-race — thread-root call graphs + guarded-by lock discipline.
+
+The on-device solve is machine-checked by the trace-safety families
+(``rules.py``); this pass covers the other half of the correctness
+story, the HOST runtime: the package runs concurrent daemon threads
+(status-updater workers, the ThreadingHTTPServer handler pool, the
+continuous-profiler sampler) against shared state — including the
+``MutationJournal`` the incremental snapshotter depends on, where one
+lost mark silently serves a stale snapshot.
+
+Three stages, all pure AST (no jax import — ``scripts/lint.py`` stays
+sub-second):
+
+1. **Thread roots** — ``threading.Thread(target=...)`` /
+   ``threading.Timer(..., fn)`` spawns and ``ThreadingHTTPServer``
+   handler classes (every ``do_*`` method runs on a per-request
+   thread).  Spawns inside loops/comprehensions and HTTP handlers are
+   *multi-instance*: their accesses conflict with themselves.
+
+2. **Per-root call graphs** — grown with the same best-effort
+   resolution style as ``callgraph.py`` plus what host code needs:
+   ``self.method()``, closure aliases of ``self`` (the ``outer = self``
+   handler idiom), parameter/assignment/return-annotation type
+   inference for package classes (``cluster.journal.mark_pod`` resolves
+   through ``Cluster.journal -> MutationJournal``).
+
+3. **Lock-context abstract interpretation** — each function body is
+   walked with the set of held locks (``with self._lock:`` regions and
+   linear ``acquire()``/``release()`` spans), propagated through
+   resolved calls.  Every attribute access on a *surface class* (one
+   that owns a thread root, or is listed in ``guarded_by.json``) is
+   recorded as ``(class, attr, root, held locks, read|write)``.
+
+Findings (the ``KAI1xx`` family, reported through the engine's
+suppression/baseline machinery):
+
+* ``KAI100`` stale ``# kai-race:`` annotation (mirrors KAI000)
+* ``KAI101`` unguarded write to shared state
+* ``KAI102`` mixed guarded/unguarded access or discipline violation
+* ``KAI103`` inconsistent lock acquisition order across paths
+* ``KAI104`` mutable class attribute shared across instances
+* ``KAI105`` blocking call while holding a lock
+
+Intent is declared inline — ``self.cluster = cluster  # kai-race:
+guarded-by=_state_lock`` — or in the checked-in package map
+(``analysis/guarded_by.json``).  Disciplines: ``guarded-by=<lockattr>``
+(every access outside ``__init__`` must hold that lock),
+``guarded-by=atomic-swap`` (the attribute is only ever rebound to fresh
+immutable values, never mutated in place), ``guarded-by=single-writer``
+(writes from at most one thread context).  An annotation that stops
+matching live shared state is itself a finding (``KAI100``), so
+documentation rots loudly.
+
+Resolution is best-effort by design, exactly like the jit call graph: a
+missed edge narrows the checked surface (a rule stays silent), never
+breaks the build.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterator
+
+from .callgraph import ModuleInfo, PackageGraph, _dotted
+from .engine import Finding, RuleCtx, rule
+
+_ANNOT_RE = re.compile(r"#\s*kai-race:\s*guarded-by=([A-Za-z0-9_\-]+)")
+
+#: methods whose call on an object mutates it in place
+_MUTATORS = frozenset({
+    "append", "add", "pop", "popitem", "clear", "update", "extend",
+    "insert", "remove", "discard", "setdefault", "sort", "reverse",
+    "appendleft", "popleft",
+})
+
+#: threading/queue constructors that ARE synchronization objects —
+#: attributes holding them are the mechanism, not the shared state
+_LOCK_TYPES = frozenset({"threading.Lock", "threading.RLock"})
+_SYNC_TYPES = _LOCK_TYPES | frozenset({
+    "threading.Event", "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "queue.Queue",
+    "queue.SimpleQueue", "queue.LifoQueue", "queue.PriorityQueue",
+})
+
+#: calls that block (I/O, sleeps, device syncs) — holding a lock across
+#: one stalls every contender (KAI105)
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "urllib.request.urlopen", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.Popen",
+})
+_BLOCKING_METHODS = frozenset({"block_until_ready"})
+
+#: ``__init__``-like methods: attribute writes there happen before the
+#: object is published to other threads
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+
+_RACE_CODES = ("KAI100", "KAI101", "KAI102", "KAI103", "KAI104",
+               "KAI105")
+
+
+def race_codes() -> tuple[str, ...]:
+    return _RACE_CODES
+
+
+# ---------------------------------------------------------------------------
+# package indexing: classes, lock attributes, type inference
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One class of the package (including nested classes)."""
+
+    modname: str
+    qual: str                      # e.g. "SchedulerServer.__init__.Handler"
+    node: ast.ClassDef
+    #: method name -> function qualname in the module
+    methods: dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_attrs: set[str] = dataclasses.field(default_factory=set)
+    sync_attrs: set[str] = dataclasses.field(default_factory=set)
+    #: attr -> (modname, classqual) for self.X = PackageClass(...) style
+    attr_types: dict[str, tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: line -> attr for every ``self.X = ...`` assignment (annotations)
+    attr_assign_lines: dict[int, str] = dataclasses.field(
+        default_factory=dict)
+    all_attrs: set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class AccessRecord:
+    """One attribute access observed during abstract interpretation."""
+
+    cls: str                 # class qual (module-local)
+    modname: str
+    attr: str
+    root: str                # thread-root id, or "main"
+    held: frozenset          # lock ids held at the access
+    write: bool
+    rebind: bool             # plain ``x.attr = ...`` (vs in-place)
+    file: str
+    line: int
+    function: str
+    multi: bool              # root spawns multiple threads
+
+
+@dataclasses.dataclass
+class ThreadRoot:
+    """One statically discovered thread entry point."""
+
+    root_id: str             # "<relpath>::<qual>" (or ::external:<expr>)
+    modname: str | None      # None for unresolved targets
+    qual: str | None
+    multi: bool              # pool/loop/per-request spawn
+    kind: str                # "thread" | "timer" | "http-handler"
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class RaceReport:
+    findings: list[Finding]
+    roots: list[ThreadRoot]
+    #: (class qual, attr) -> discipline string for every declared attr
+    disciplines: dict[tuple[str, str], str]
+    #: number of live (non-stale) inline annotations
+    live_annotations: int = 0
+
+
+def _expr_type(mod: ModuleInfo, node: ast.AST) -> str | None:
+    """Fully-qualified dotted name of a call/attribute chain, with the
+    module's import aliases resolved (``threading.Thread`` stays,
+    ``Thread`` imported from threading becomes ``threading.Thread``)."""
+    d = _dotted(node)
+    if d is None:
+        return None
+    base = d.split(".")[0]
+    target = mod.alias_root(base)
+    if target is None:
+        return d
+    return ".".join([target] + d.split(".")[1:])
+
+
+class _Index:
+    """Whole-package class/type index the interpreter resolves against."""
+
+    def __init__(self, graph: PackageGraph):
+        self.graph = graph
+        #: (modname, classqual) -> ClassInfo
+        self.classes: dict[tuple[str, str], ClassInfo] = {}
+        #: modname -> {local class name -> classqual} (top-level only)
+        self._top: dict[str, dict[str, str]] = {}
+        #: (modname, global name) -> (modname, classqual) instance type
+        self.globals: dict[tuple[str, str], tuple[str, str]] = {}
+        #: function qualname -> owning (modname, classqual)
+        self.owner: dict[tuple[str, str], tuple[str, str]] = {}
+        for modname, mod in graph.modules.items():
+            self._scan_classes(modname, mod)
+        for modname, mod in graph.modules.items():
+            self._scan_types(modname, mod)
+
+    # -- discovery --------------------------------------------------------
+
+    def _scan_classes(self, modname: str, mod: ModuleInfo) -> None:
+        top = self._top.setdefault(modname, {})
+
+        def walk(body, prefix):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    qual = prefix + node.name
+                    info = ClassInfo(modname=modname, qual=qual, node=node)
+                    self.classes[(modname, qual)] = info
+                    if not prefix:
+                        top[node.name] = qual
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            fq = f"{qual}.{sub.name}"
+                            info.methods[sub.name] = fq
+                            self.owner[(modname, fq)] = (modname, qual)
+                    walk(node.body, qual + ".")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    walk(node.body, prefix + node.name + ".")
+
+        walk(mod.tree.body, "")
+
+    def resolve_class(self, modname: str,
+                      name: str) -> tuple[str, str] | None:
+        """Resolve a local name to a package class (same module, or one
+        from-import hop, or one ``__init__`` re-export)."""
+        mod = self.graph.modules.get(modname)
+        if mod is None:
+            return None
+        qual = self._top.get(modname, {}).get(name)
+        if qual is not None:
+            return modname, qual
+        if name in mod.sym_imports:
+            src_mod, orig = mod.sym_imports[name]
+            for cand in (src_mod, src_mod + ".__init__"):
+                got = self._top.get(cand, {}).get(orig)
+                if got is not None:
+                    return cand, got
+                sub = self.graph.modules.get(cand)
+                if sub is not None and orig in sub.sym_imports:
+                    m2, o2 = sub.sym_imports[orig]
+                    got = self._top.get(m2, {}).get(o2)
+                    if got is not None:
+                        return m2, got
+        return None
+
+    def _class_of_call(self, modname: str,
+                       expr: ast.AST) -> tuple[str, str] | None:
+        """Instance type of an expression, if it (or a subexpression)
+        constructs a package class or calls a function whose return
+        annotation names one."""
+        mod = self.graph.modules[modname]
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Name):
+                cls = self.resolve_class(modname, sub.func.id)
+                if cls is not None:
+                    return cls
+                fn = self.graph._resolve_call(mod, sub.func)
+                if fn is not None:
+                    ret = self._return_type(*fn)
+                    if ret is not None:
+                        return ret
+            elif isinstance(sub.func, ast.Attribute):
+                full = _expr_type(mod, sub.func)
+                if full and "." in full:
+                    head, meth = full.rsplit(".", 1)
+                    cls = self._resolve_dotted_class(modname, head)
+                    if cls is not None:
+                        info = self.classes.get(cls)
+                        if info and meth in info.methods:
+                            ret = self._return_type(cls[0],
+                                                    info.methods[meth])
+                            if ret is not None:
+                                return ret
+                        if info and meth == info.name:
+                            return cls
+                # typed same-module global receiver:
+                # ``registry.histogram(...)`` -> Registry.histogram's
+                # return annotation
+                if isinstance(sub.func.value, ast.Name):
+                    g = self.globals.get((modname, sub.func.value.id))
+                    if g is not None:
+                        info = self.classes.get(g)
+                        if info and sub.func.attr in info.methods:
+                            ret = self._return_type(
+                                g[0], info.methods[sub.func.attr])
+                            if ret is not None:
+                                return ret
+        return None
+
+    def _resolve_dotted_class(self, modname: str,
+                              dotted: str) -> tuple[str, str] | None:
+        """``pkg.mod.Class`` -> class, for alias-resolved chains."""
+        if "." not in dotted:
+            return self.resolve_class(modname, dotted)
+        mod_part, cls_part = dotted.rsplit(".", 1)
+        got = self._top.get(mod_part, {}).get(cls_part)
+        if got is not None:
+            return mod_part, got
+        got = self._top.get(mod_part + ".__init__", {}).get(cls_part)
+        if got is not None:
+            return mod_part + ".__init__", got
+        return None
+
+    def _annotation_type(self, modname: str,
+                         ann: ast.AST | None) -> tuple[str, str] | None:
+        """Package class named by a parameter/return annotation (also
+        inside ``X | None`` unions and ``"X"`` string forms)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        found = []
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Name):
+                cls = self.resolve_class(modname, sub.id)
+                if cls is not None:
+                    found.append(cls)
+            elif isinstance(sub, ast.Attribute):
+                full = _expr_type(self.graph.modules[modname], sub)
+                if full:
+                    cls = self._resolve_dotted_class(modname, full)
+                    if cls is not None:
+                        found.append(cls)
+        return found[0] if len(found) == 1 else None
+
+    def _return_type(self, modname: str,
+                     qual: str) -> tuple[str, str] | None:
+        fn = self.graph.modules[modname].functions.get(qual)
+        if fn is None:
+            return None
+        return self._annotation_type(modname, getattr(fn, "returns", None))
+
+    def _scan_types(self, modname: str, mod: ModuleInfo) -> None:
+        # module-level typed globals: registry = Registry() etc.
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                cls = self._class_of_call(modname, node.value)
+                if cls is not None:
+                    self.globals[(modname, node.targets[0].id)] = cls
+        # per-class: lock/sync attrs, attr types, assignment lines
+        for (cmod, cqual), info in self.classes.items():
+            if cmod != modname:
+                continue
+            self._scan_class_body(mod, info)
+
+    def _scan_class_body(self, mod: ModuleInfo, info: ClassInfo) -> None:
+        # dataclass-style annotated fields at class level
+        for node in info.node.body:
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                info.all_attrs.add(node.target.id)
+                ann = self._ann_dotted(mod, node.annotation)
+                if ann in _LOCK_TYPES:
+                    info.lock_attrs.add(node.target.id)
+                elif ann in _SYNC_TYPES:
+                    info.sync_attrs.add(node.target.id)
+        # instance attributes assigned in methods
+        for mname, fq in info.methods.items():
+            fn = mod.functions.get(fq)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                targets: list[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    info.all_attrs.add(t.attr)
+                    info.attr_assign_lines.setdefault(node.lineno, t.attr)
+                    vt = self._ctor_type(mod, value) \
+                        if value is not None else None
+                    if vt in _LOCK_TYPES:
+                        info.lock_attrs.add(t.attr)
+                    elif vt in _SYNC_TYPES:
+                        info.sync_attrs.add(t.attr)
+                    elif value is not None \
+                            and t.attr not in info.attr_types:
+                        cls = self._class_of_call(info.modname, value)
+                        if cls is not None:
+                            info.attr_types[t.attr] = cls
+
+    @staticmethod
+    def _ctor_type(mod: ModuleInfo, value: ast.AST) -> str | None:
+        """Dotted type a value expression constructs, searching through
+        wrappers like ``lock if lock is not None else threading.Lock()``
+        or ``dataclasses.field(default_factory=threading.Lock)``."""
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                full = _expr_type(mod, sub.func)
+                if full in _SYNC_TYPES:
+                    return full
+            elif isinstance(sub, (ast.Attribute, ast.Name)):
+                full = _expr_type(mod, sub)
+                if full in _SYNC_TYPES:
+                    return full
+        return None
+
+    def _ann_dotted(self, mod: ModuleInfo, ann: ast.AST) -> str | None:
+        # unwrap ``x: threading.Lock = field(...)`` style annotations
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        return _expr_type(mod, ann)
+
+
+# ---------------------------------------------------------------------------
+# thread-root discovery
+# ---------------------------------------------------------------------------
+
+
+def _iter_spawns(mod: ModuleInfo) -> Iterator[tuple[ast.Call, str, bool]]:
+    """(spawn call, kind, multi) for every thread/timer spawn, where
+    ``multi`` means the spawn site sits inside a loop/comprehension."""
+
+    def walk(node, in_loop):
+        loopy = in_loop or isinstance(
+            node, (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+                   ast.SetComp, ast.GeneratorExp, ast.DictComp))
+        if isinstance(node, ast.Call):
+            full = _expr_type(mod, node.func)
+            if full == "threading.Thread":
+                yield node, "thread", loopy
+            elif full == "threading.Timer":
+                yield node, "timer", loopy
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, loopy)
+
+    yield from walk(mod.tree, False)
+
+
+def _spawn_target(call: ast.Call, kind: str) -> ast.AST | None:
+    if kind == "thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if kind == "timer" and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _resolve_target(index: _Index, mod: ModuleInfo, fn_qual: str | None,
+                    target: ast.AST) -> tuple[str, str] | None:
+    """Resolve a spawn target expression to (modname, function qual)."""
+    if isinstance(target, ast.Name):
+        resolved = index.graph._resolve_call(mod, target)
+        return resolved
+    if isinstance(target, ast.Attribute) and isinstance(target.value,
+                                                        ast.Name):
+        base = target.value.id
+        owner = None
+        if base == "self" and fn_qual is not None:
+            owner = index.owner.get((mod.modname, fn_qual))
+        if owner is not None:
+            info = index.classes.get(owner)
+            if info is not None and target.attr in info.methods:
+                return owner[0], info.methods[target.attr]
+    return None
+
+
+def _spawn_sites(index: _Index) -> list[tuple[str, ast.Call, str, bool,
+                                              str | None]]:
+    """(modname, spawn call, kind, multi, containing function qual) for
+    every thread/timer spawn in the package — computed once and shared
+    by root discovery and surface selection (the containing-function
+    map costs a full AST walk per module)."""
+    out = []
+    for modname in sorted(index.graph.modules):
+        mod = index.graph.modules[modname]
+        containing: dict[int, str] = {}
+        for qual, fn in mod.functions.items():
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    containing.setdefault(id(sub), qual)
+        for call, kind, multi in _iter_spawns(mod):
+            out.append((modname, call, kind, multi,
+                        containing.get(id(call))))
+    return out
+
+
+def discover_roots(index: _Index,
+                   spawns: list | None = None) -> list[ThreadRoot]:
+    roots: list[ThreadRoot] = []
+    seen: set[str] = set()
+
+    def add(root: ThreadRoot) -> None:
+        if root.root_id not in seen:
+            seen.add(root.root_id)
+            roots.append(root)
+
+    if spawns is None:
+        spawns = _spawn_sites(index)
+    for modname, call, kind, multi, fn_qual in spawns:
+        mod = index.graph.modules[modname]
+        target = _spawn_target(call, kind)
+        if target is None:
+            continue
+        resolved = _resolve_target(index, mod, fn_qual, target)
+        if resolved is not None:
+            rmod, rqual = resolved
+            rel = index.graph.modules[rmod].relpath
+            add(ThreadRoot(
+                root_id=f"{rel}::{rqual}", modname=rmod, qual=rqual,
+                multi=multi, kind=kind, file=mod.relpath,
+                line=call.lineno))
+        else:
+            expr = ast.unparse(target)
+            add(ThreadRoot(
+                root_id=f"{mod.relpath}::external:{expr}",
+                modname=None, qual=None, multi=multi, kind=kind,
+                file=mod.relpath, line=call.lineno))
+    for modname in sorted(index.graph.modules):
+        mod = index.graph.modules[modname]
+        # ThreadingHTTPServer(addr, Handler): every do_* method of the
+        # handler class runs on a per-request thread
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            full = _expr_type(mod, node.func)
+            if full not in ("http.server.ThreadingHTTPServer",
+                            "socketserver.ThreadingTCPServer"):
+                continue
+            handler = node.args[1]
+            if not isinstance(handler, ast.Name):
+                continue
+            # the handler class may be nested in the enclosing function
+            cand = [
+                (m, q) for (m, q), info in index.classes.items()
+                if m == modname and info.name == handler.id]
+            for cmod, cqual in sorted(cand):
+                info = index.classes[(cmod, cqual)]
+                for mname, fq in sorted(info.methods.items()):
+                    if mname.startswith("do_"):
+                        add(ThreadRoot(
+                            root_id=f"{mod.relpath}::{fq}",
+                            modname=cmod, qual=fq, multi=True,
+                            kind="http-handler", file=mod.relpath,
+                            line=info.node.lineno))
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# lock-context abstract interpretation
+# ---------------------------------------------------------------------------
+
+
+class _Interp:
+    """Walks function bodies under a held-lock context, recording
+    surface-class attribute accesses, lock orderings, and blocking
+    calls."""
+
+    def __init__(self, index: _Index, surface: set[tuple[str, str]]):
+        self.index = index
+        self.surface = surface
+        self.accesses: list[AccessRecord] = []
+        #: (outer lock, inner lock) -> first (file, line) observed
+        self.order: dict[tuple, tuple[str, int]] = {}
+        self.blocking: list[tuple[str, int, str, str]] = []
+        self._seen: set[tuple] = set()
+        self._root: str = "main"
+        self._multi: bool = False
+
+    # -- entry ------------------------------------------------------------
+
+    def run_root(self, modname: str, qual: str, root: str,
+                 multi: bool) -> None:
+        self._root, self._multi = root, multi
+        self._visit_function(modname, qual, frozenset())
+
+    def _visit_function(self, modname: str, qual: str,
+                        held: frozenset) -> None:
+        key = (modname, qual, held, self._root)
+        if key in self._seen or len(self._seen) > 4000:
+            return
+        self._seen.add(key)
+        mod = self.index.graph.modules.get(modname)
+        fn = mod.functions.get(qual) if mod is not None else None
+        if fn is None:
+            return
+        aliases = self._self_aliases(mod, qual)
+        locals_ = self._local_types(mod, fn, qual, aliases)
+        self._walk_block(mod, qual, fn.body, held, aliases, locals_)
+
+    # -- scope helpers ----------------------------------------------------
+
+    def _self_aliases(self, mod: ModuleInfo,
+                      qual: str) -> dict[str, tuple[str, str]]:
+        """Names bound to an instance of a known class inside ``qual``:
+        ``self`` (the owning class) plus ``outer = self`` closure
+        aliases inherited from enclosing defs (the nested
+        ThreadingHTTPServer handler idiom)."""
+        out: dict[str, tuple[str, str]] = {}
+        parts = qual.split(".")
+        # enclosing def chain, outermost first, so inner bindings win
+        for i in range(1, len(parts)):
+            prefix = ".".join(parts[:i])
+            owner = self.index.owner.get((mod.modname, prefix))
+            fn = mod.functions.get(prefix)
+            if owner is None or fn is None:
+                continue
+            for node in fn.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    out[node.targets[0].id] = owner
+        me = self.index.owner.get((mod.modname, qual))
+        if me is not None:
+            out["self"] = me
+        return out
+
+    def _local_types(self, mod: ModuleInfo, fn: ast.AST, qual: str,
+                     aliases: dict) -> dict[str, tuple[str, str]]:
+        out: dict[str, tuple[str, str]] = {}
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg == "self":
+                continue
+            t = self.index._annotation_type(mod.modname, a.annotation)
+            if t is not None:
+                out[a.arg] = t
+        # two passes so ``j = c.journal`` chains through ``c = ...``
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                name = node.targets[0].id
+                if name in out:
+                    continue
+                t = self.index._class_of_call(mod.modname, node.value)
+                if t is None and isinstance(node.value,
+                                            (ast.Name, ast.Attribute)):
+                    t = self._instance_of(mod, node.value, aliases, out)
+                if t is not None:
+                    out[name] = t
+        return out
+
+    def _instance_of(self, mod, expr, aliases, locals_):
+        """(modname, classqual) an expression statically refers to."""
+        if isinstance(expr, ast.Name):
+            if expr.id in aliases:
+                return aliases[expr.id]
+            if expr.id in locals_:
+                return locals_[expr.id]
+            g = self.index.globals.get((mod.modname, expr.id))
+            if g is not None:
+                return g
+            if expr.id in mod.sym_imports:
+                src_mod, orig = mod.sym_imports[expr.id]
+                return self.index.globals.get((src_mod, orig))
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self._instance_of(mod, expr.value, aliases, locals_)
+            if base is not None:
+                info = self.index.classes.get(base)
+                if info is not None:
+                    return info.attr_types.get(expr.attr)
+            # module attribute: metrics.registry
+            if isinstance(expr.value, ast.Name):
+                target_mod = mod.alias_root(expr.value.id)
+                if target_mod is not None:
+                    return self.index.globals.get(
+                        (target_mod, expr.attr)) or \
+                        self.index.globals.get(
+                            (target_mod + ".__init__", expr.attr))
+        return None
+
+    def _lock_id(self, mod, expr, aliases, locals_):
+        """Identify a lock expression: ``self._lock`` / ``outer._x`` /
+        a module-level lock global -> a stable hashable id."""
+        if isinstance(expr, ast.Attribute):
+            base = self._instance_of(mod, expr.value, aliases, locals_)
+            if base is not None:
+                info = self.index.classes.get(base)
+                if info is not None and expr.attr in info.lock_attrs:
+                    return (base[1], expr.attr)
+        if isinstance(expr, ast.Name):
+            # module-level ``_lock = threading.Lock()``
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == expr.id \
+                        and _expr_type(mod, node.value) in _LOCK_TYPES:
+                    return (mod.modname, expr.id)
+        return None
+
+    # -- the walk ---------------------------------------------------------
+
+    def _walk_block(self, mod, qual, stmts, held, aliases, locals_):
+        held = set(held)
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                inner = set(held)
+                for item in stmt.items:
+                    self._scan_expr(mod, qual, item.context_expr,
+                                    frozenset(held), aliases, locals_)
+                    lid = self._lock_id(mod, item.context_expr, aliases,
+                                        locals_)
+                    if lid is not None:
+                        self._note_order(held, lid, mod, stmt)
+                        inner.add(lid)
+                self._walk_block(mod, qual, stmt.body, frozenset(inner),
+                                 aliases, locals_)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs run later, under their own roots
+            # acquire()/release() spans within this block
+            acq = self._acquire_toggle(mod, stmt, aliases, locals_)
+            if acq is not None:
+                lid, acquire = acq
+                if acquire:
+                    self._note_order(held, lid, mod, stmt)
+                    held.add(lid)
+                else:
+                    held.discard(lid)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                self._scan_expr(mod, qual, stmt.test, frozenset(held),
+                                aliases, locals_)
+                self._walk_block(mod, qual, stmt.body, frozenset(held),
+                                 aliases, locals_)
+                self._walk_block(mod, qual, stmt.orelse, frozenset(held),
+                                 aliases, locals_)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(mod, qual, stmt.iter, frozenset(held),
+                                aliases, locals_)
+                self._walk_block(mod, qual, stmt.body, frozenset(held),
+                                 aliases, locals_)
+                self._walk_block(mod, qual, stmt.orelse, frozenset(held),
+                                 aliases, locals_)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk_block(mod, qual, blk, frozenset(held),
+                                     aliases, locals_)
+                for h in stmt.handlers:
+                    self._walk_block(mod, qual, h.body, frozenset(held),
+                                     aliases, locals_)
+            else:
+                self._scan_expr(mod, qual, stmt, frozenset(held),
+                                aliases, locals_)
+
+    def _acquire_toggle(self, mod, stmt, aliases, locals_):
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr in ("acquire", "release")):
+            return None
+        lid = self._lock_id(mod, stmt.value.func.value, aliases, locals_)
+        if lid is None:
+            return None
+        return lid, stmt.value.func.attr == "acquire"
+
+    def _note_order(self, held, inner, mod, node) -> None:
+        for outer_lock in held:
+            if outer_lock != inner:
+                self.order.setdefault(
+                    (outer_lock, inner), (mod.relpath, node.lineno))
+
+    # -- expression scanning ----------------------------------------------
+
+    def _scan_expr(self, mod, qual, node, held, aliases, locals_):
+        writes: dict[int, bool] = {}  # id(Attribute) -> rebind?
+
+        def mark_write(attr_node, rebind):
+            if isinstance(attr_node, ast.Attribute):
+                writes[id(attr_node)] = rebind
+
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.Delete,
+                                ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [getattr(sub, "target", None)]
+                           if not isinstance(sub, ast.Delete)
+                           else sub.targets)
+                for t in targets:
+                    if t is None:
+                        continue
+                    if isinstance(t, ast.Attribute):
+                        mark_write(t, isinstance(sub, ast.Assign)
+                                   or isinstance(sub, ast.AnnAssign))
+                    elif isinstance(t, (ast.Subscript, ast.Starred)):
+                        mark_write(t.value, False)
+                    elif isinstance(t, ast.Tuple):
+                        for e in t.elts:
+                            if isinstance(e, ast.Attribute):
+                                mark_write(e, True)
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATORS:
+                mark_write(sub.func.value, False)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_blocking(mod, qual, sub, held, aliases,
+                                     locals_)
+                self._propagate_call(mod, qual, sub, held, aliases,
+                                     locals_)
+            if not isinstance(sub, ast.Attribute):
+                continue
+            base = self._instance_of(mod, sub.value, aliases, locals_)
+            if base is None or base not in self.surface:
+                continue
+            info = self.index.classes.get(base)
+            if info is None or sub.attr in info.lock_attrs \
+                    or sub.attr in info.sync_attrs:
+                continue
+            if sub.attr in info.methods:
+                continue  # bound-method reference, not state
+            # writes in the owning class's __init__ happen before the
+            # object is shared
+            fname = qual.rsplit(".", 1)[-1]
+            if fname in _INIT_METHODS \
+                    and self.index.owner.get((mod.modname, qual)) == base:
+                continue
+            self.accesses.append(AccessRecord(
+                cls=base[1], modname=base[0], attr=sub.attr,
+                root=self._root, held=held,
+                write=id(sub) in writes,
+                rebind=writes.get(id(sub), False),
+                file=mod.relpath, line=sub.lineno, function=qual,
+                multi=self._multi))
+
+    def _check_blocking(self, mod, qual, call, held, aliases, locals_):
+        if not held:
+            return
+        full = _expr_type(mod, call.func)
+        name = None
+        if full in _BLOCKING_DOTTED:
+            name = full
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _BLOCKING_METHODS:
+            name = f".{call.func.attr}()"
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("get", "put") \
+                and isinstance(call.func.value, ast.Attribute):
+            # a blocking queue op on a queue-typed attribute
+            recv = call.func.value
+            base = self._instance_of(mod, recv.value, aliases, locals_)
+            info = self.index.classes.get(base) if base else None
+            if info is not None and recv.attr in info.sync_attrs:
+                nonblocking = any(
+                    k.arg == "block" and isinstance(k.value, ast.Constant)
+                    and k.value.value is False for k in call.keywords)
+                if not nonblocking:
+                    name = f"queue .{call.func.attr}()"
+        if name is not None:
+            locks = ", ".join(sorted(".".join(l) for l in held))
+            self.blocking.append((
+                mod.relpath, call.lineno, qual,
+                f"blocking call {name} while holding [{locks}] stalls "
+                f"every contender on the lock — move the slow operation "
+                f"outside the critical section"))
+
+    def _propagate_call(self, mod, qual, call, held, aliases, locals_):
+        func = call.func
+        resolved = None
+        if isinstance(func, ast.Name):
+            # NB: constructor calls are NOT traversed — writes during
+            # construction happen before the object is published
+            resolved = self.index.graph._resolve_call(mod, func)
+        elif isinstance(func, ast.Attribute):
+            base = self._instance_of(mod, func.value, aliases, locals_)
+            if base is not None:
+                info = self.index.classes.get(base)
+                if info is not None and func.attr in info.methods:
+                    resolved = (base[0], info.methods[func.attr])
+            if resolved is None:
+                resolved = self.index.graph._resolve_call(mod, func)
+        if resolved is not None:
+            self._visit_function(resolved[0], resolved[1], held)
+
+
+# ---------------------------------------------------------------------------
+# annotations + the package guarded-by map
+# ---------------------------------------------------------------------------
+
+
+def _iter_annotation_comments(source: str) -> Iterator[
+        tuple[int, bool, str]]:
+    """(line, own_line, value) for every real ``# kai-race:`` COMMENT
+    token — example annotations inside docstrings/fixture strings are
+    inert, exactly like the engine's suppression parser."""
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _ANNOT_RE.search(tok.string)
+        if not m:
+            continue
+        row, col = tok.start
+        yield row, tok.line[:col].strip() == "", m.group(1)
+
+
+def _parse_annotations(index: _Index) -> tuple[
+        dict[tuple[str, str], str], list[tuple[str, int, str]]]:
+    """Inline ``# kai-race: guarded-by=X`` comments.
+
+    Returns (declared disciplines keyed by (class qual, attr), orphan
+    annotations that bind to no ``self.X = ...`` line)."""
+    declared: dict[tuple[str, str], str] = {}
+    orphans: list[tuple[str, int, str]] = []
+    for modname in sorted(index.graph.modules):
+        mod = index.graph.modules[modname]
+        attr_lines: dict[int, tuple[str, str]] = {}
+        for (cmod, cqual), info in index.classes.items():
+            if cmod != modname:
+                continue
+            for line, attr in info.attr_assign_lines.items():
+                attr_lines[line] = (cqual, attr)
+        for row, own, value in _iter_annotation_comments(mod.source):
+            # own-line comments bind to the next line
+            bind = attr_lines.get(row + 1 if own else row)
+            if bind is None:
+                orphans.append((mod.relpath, row, value))
+                continue
+            declared[bind] = _normalize_discipline(value)
+    return declared, orphans
+
+
+def _normalize_discipline(value: str) -> str:
+    if value in ("atomic-swap", "single-writer"):
+        return value
+    return f"lock:{value}"
+
+
+def default_map_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "guarded_by.json")
+
+
+def load_guarded_map(path: str | None = None) -> dict:
+    path = path or default_map_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the analysis driver
+# ---------------------------------------------------------------------------
+
+
+def analyze_package(graph: PackageGraph,
+                    guarded_map: dict | None = None) -> RaceReport:
+    """Run the full kai-race pass over an AST graph.
+
+    Returns every raw finding (suppressions/baseline are the engine's
+    job) plus the discovered thread roots and declared disciplines.
+    """
+    index = _Index(graph)
+    guarded_map = guarded_map or {}
+    spawns = _spawn_sites(index)
+    roots = discover_roots(index, spawns)
+    surface = _surface_classes(index, roots, guarded_map, spawns)
+
+    interp = _Interp(index, surface)
+    for r in roots:
+        if r.modname is not None:
+            interp.run_root(r.modname, r.qual, r.root_id, r.multi)
+    _seed_main_contexts(index, interp, surface, roots)
+
+    declared_inline, orphans = _parse_annotations(index)
+    declared = dict(declared_inline)
+    for cname, spec in guarded_map.get("classes", {}).items():
+        for attr, value in spec.get("attrs", {}).items():
+            for _key, info in index.classes.items():
+                if info.name == cname:
+                    declared.setdefault((info.qual, attr),
+                                        _normalize_discipline(value))
+
+    findings: list[Finding] = []
+    findings.extend(_judge(index, interp, declared, orphans))
+    findings.extend(_stale_annotation_findings(index, interp,
+                                               declared_inline))
+    findings.extend(_lock_order_findings(interp))
+    findings.extend(_mutable_class_attr_findings(index))
+    findings.extend(
+        Finding(file=f, line=line, col=0, code="KAI105", message=msg,
+                function=qual)
+        for f, line, qual, msg in interp.blocking)
+    live = _count_live_annotations(index, interp, declared_inline)
+    return RaceReport(findings=sorted(set(findings)), roots=roots,
+                      disciplines=declared, live_annotations=live)
+
+
+def _surface_classes(index: _Index, roots: list[ThreadRoot],
+                     guarded_map: dict,
+                     spawns: list | None = None) -> set[tuple[str, str]]:
+    """Classes whose instance state the pass tracks: root owners, their
+    enclosing instances (nested handler classes), thread spawners, and
+    everything the checked-in map lists."""
+    surface: set[tuple[str, str]] = set()
+    for r in roots:
+        if r.modname is None:
+            continue
+        owner = index.owner.get((r.modname, r.qual))
+        if owner is not None:
+            surface.add(owner)
+        parts = (r.qual or "").split(".")
+        for i in range(1, len(parts)):
+            enc = index.owner.get((r.modname, ".".join(parts[:i])))
+            if enc is not None:
+                surface.add(enc)
+    if spawns is None:
+        spawns = _spawn_sites(index)
+    for modname, _call, _kind, _multi, fq in spawns:
+        if fq is not None:
+            owner = index.owner.get((modname, fq))
+            if owner is not None:
+                surface.add(owner)
+    for cname, spec in guarded_map.get("classes", {}).items():
+        for key, info in index.classes.items():
+            if info.name == cname and (
+                    not spec.get("module")
+                    or index.graph.modules[key[0]].relpath
+                    == spec["module"]):
+                surface.add(key)
+    return surface
+
+
+def _seed_main_contexts(index: _Index, interp: _Interp,
+                        surface: set[tuple[str, str]],
+                        roots: list[ThreadRoot]) -> None:
+    """Analyze every externally-callable method of a surface class in
+    the "main" context.  Underscore helpers with an internal ``self.``
+    caller are reached through propagation instead — they inherit the
+    caller's lock context (``_reset`` called under ``consume``'s lock
+    must not be condemned for having no ``with`` of its own)."""
+    root_quals = {(r.modname, r.qual) for r in roots}
+    for key in sorted(surface):
+        info = index.classes[key]
+        mod = index.graph.modules[key[0]]
+        internal_callees: set[str] = set()
+        for fq in info.methods.values():
+            fn = mod.functions.get(fq)
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id == "self":
+                    internal_callees.add(sub.func.attr)
+        for mname in sorted(info.methods):
+            if mname in _INIT_METHODS:
+                continue
+            if mname.startswith("_") and mname in internal_callees:
+                continue
+            if (key[0], info.methods[mname]) in root_quals:
+                continue
+            interp.run_root(key[0], info.methods[mname], "main", False)
+
+
+def _group_accesses(interp: _Interp) -> dict[tuple[str, str],
+                                             list[AccessRecord]]:
+    grouped: dict[tuple[str, str], list[AccessRecord]] = {}
+    for rec in interp.accesses:
+        grouped.setdefault((rec.cls, rec.attr), []).append(rec)
+    return grouped
+
+
+def _is_shared(recs: list[AccessRecord]) -> bool:
+    roots = {r.root for r in recs}
+    multi = any(r.multi for r in recs)
+    return len(roots) >= 2 or multi
+
+
+def _judge(index: _Index, interp: _Interp, declared, orphans
+           ) -> Iterator[Finding]:
+    for relpath, line, value in orphans:
+        yield Finding(
+            file=relpath, line=line, col=0, code="KAI100",
+            message=(f"kai-race annotation `guarded-by={value}` is not "
+                     f"attached to a `self.<attr> = ...` assignment — "
+                     f"move it onto (or directly above) the attribute "
+                     f"initialization"))
+    grouped = _group_accesses(interp)
+    for (cls, attr) in sorted(grouped):
+        recs = sorted(grouped[(cls, attr)],
+                      key=lambda r: (r.file, r.line))
+        discipline = declared.get((cls, attr))
+        shared = _is_shared(recs)
+        if discipline is not None:
+            yield from _judge_declared(cls, attr, recs, discipline)
+            continue
+        writes = [r for r in recs if r.write]
+        if not shared or not writes:
+            continue  # single-context, or immutable-after-init
+        common = frozenset.intersection(*(r.held for r in recs))
+        if common:
+            continue  # uniformly guarded by one lock
+        guarded = [r for r in recs if r.held]
+        unguarded = [r for r in recs if not r.held]
+        if guarded and unguarded:
+            r = unguarded[0]
+            locks = ", ".join(sorted({
+                ".".join(l) for rec in guarded for l in rec.held}))
+            yield Finding(
+                file=r.file, line=r.line, col=0, code="KAI102",
+                message=(f"{cls}.{attr} is accessed both under a lock "
+                         f"({locks}) and without one — hold the lock on "
+                         f"every access, or declare the discipline with "
+                         f"`# kai-race: guarded-by=...`"),
+                function=r.function)
+        elif not guarded:
+            r = sorted(writes, key=lambda w: (w.file, w.line))[0]
+            roots = sorted({rec.root for rec in recs})
+            yield Finding(
+                file=r.file, line=r.line, col=0, code="KAI101",
+                message=(f"unguarded write to {cls}.{attr}, shared "
+                         f"across thread contexts [{', '.join(roots)}] "
+                         f"— guard with a lock or declare "
+                         f"`# kai-race: guarded-by=...`"),
+                function=r.function)
+        else:
+            # every access guarded, but by disagreeing locks
+            r = recs[0]
+            locks = sorted({".".join(l) for rec in recs
+                            for l in rec.held})
+            yield Finding(
+                file=r.file, line=r.line, col=0, code="KAI102",
+                message=(f"{cls}.{attr} is guarded by different locks "
+                         f"on different paths ({', '.join(locks)}) — "
+                         f"accesses do not exclude each other"),
+                function=r.function)
+
+
+def _judge_declared(cls, attr, recs, discipline) -> Iterator[Finding]:
+    if discipline.startswith("lock:"):
+        lock = discipline.split(":", 1)[1]
+        for r in recs:
+            # exact lock identity: the attribute's own class must own
+            # the held lock — another class's same-NAMED lock (half the
+            # package calls its lock `_lock`) excludes nothing
+            if (cls, lock) not in r.held:
+                yield Finding(
+                    file=r.file, line=r.line, col=0, code="KAI102",
+                    message=(f"{cls}.{attr} is declared "
+                             f"guarded-by={lock} but this "
+                             f"{'write' if r.write else 'read'} does "
+                             f"not hold it"),
+                    function=r.function)
+    elif discipline == "atomic-swap":
+        for r in recs:
+            if r.write and not r.rebind:
+                yield Finding(
+                    file=r.file, line=r.line, col=0, code="KAI102",
+                    message=(f"{cls}.{attr} is declared atomic-swap "
+                             f"(rebind-only) but is mutated in place "
+                             f"here — build a fresh value and rebind"),
+                    function=r.function)
+    elif discipline == "single-writer":
+        writer_roots = sorted({r.root for r in recs if r.write})
+        if len(writer_roots) > 1:
+            r = [x for x in recs if x.write][0]
+            yield Finding(
+                file=r.file, line=r.line, col=0, code="KAI102",
+                message=(f"{cls}.{attr} is declared single-writer but "
+                         f"is written from multiple thread contexts "
+                         f"{writer_roots}"),
+                function=r.function)
+
+
+def _count_live_annotations(index, interp, declared_inline) -> int:
+    grouped = _group_accesses(interp)
+    return sum(1 for key in declared_inline if key in grouped
+               and _is_shared(grouped[key]))
+
+
+def _stale_annotation_findings(index: _Index, interp: _Interp,
+                               declared_inline: dict,
+                               ) -> Iterator[Finding]:
+    """KAI100 for inline annotations whose attribute no longer matches
+    live shared state (map entries stay lenient — they document the
+    audit and are pinned by the thread-root meta-test instead)."""
+    grouped = _group_accesses(interp)
+    for (cls, attr), value in sorted(declared_inline.items()):
+        loc = _annotation_location(index, cls, attr)
+        if loc is None:
+            continue
+        if value.startswith("lock:"):
+            lock = value.split(":", 1)[1]
+            owner = next((info for info in index.classes.values()
+                          if info.qual == cls), None)
+            if owner is not None and lock not in owner.lock_attrs:
+                yield Finding(
+                    file=loc[0], line=loc[1], col=0, code="KAI100",
+                    message=(f"stale kai-race annotation: {cls} has no "
+                             f"lock attribute `{lock}`"))
+                continue
+        recs = grouped.get((cls, attr))
+        if not recs or not _is_shared(recs):
+            yield Finding(
+                file=loc[0], line=loc[1], col=0, code="KAI100",
+                message=(f"stale kai-race annotation on {cls}.{attr}: "
+                         f"no shared cross-thread access observed — "
+                         f"remove the annotation or re-check thread-"
+                         f"root discovery"))
+
+
+def _annotation_location(index: _Index, cls: str,
+                         attr: str) -> tuple[str, int] | None:
+    for key, info in index.classes.items():
+        if info.qual != cls:
+            continue
+        mod = index.graph.modules[key[0]]
+        lines = mod.source.splitlines()
+        for line, a in sorted(info.attr_assign_lines.items()):
+            if a != attr:
+                continue
+            if line <= len(lines) and _ANNOT_RE.search(lines[line - 1]):
+                return (mod.relpath, line)
+            if line >= 2 and _ANNOT_RE.search(lines[line - 2]):
+                return (mod.relpath, line - 1)
+    return None
+
+
+def _lock_order_findings(interp: _Interp) -> list[Finding]:
+    out = []
+    seen_pairs = set()
+    for (a, b), loc in sorted(interp.order.items()):
+        if (b, a) in interp.order and frozenset((a, b)) not in seen_pairs:
+            seen_pairs.add(frozenset((a, b)))
+            loc2 = interp.order[(b, a)]
+            where = max(loc, loc2)  # the later acquisition site
+            out.append(Finding(
+                file=where[0], line=where[1], col=0, code="KAI103",
+                message=(f"inconsistent lock order: "
+                         f"{'.'.join(a)} -> {'.'.join(b)} on one path "
+                         f"and {'.'.join(b)} -> {'.'.join(a)} on "
+                         f"another — deadlock window; pick one order")))
+    return out
+
+
+def _mutable_class_attr_findings(index: _Index) -> Iterator[Finding]:
+    for (modname, cqual), info in sorted(index.classes.items()):
+        mod = index.graph.modules[modname]
+        for node in info.node.body:
+            value = node.value if isinstance(
+                node, (ast.Assign, ast.AnnAssign)) else None
+            if value is None:
+                continue
+            mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                         ast.ListComp, ast.DictComp,
+                                         ast.SetComp)) \
+                or (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in ("list", "dict", "set",
+                                          "bytearray"))
+            if mutable:
+                yield Finding(
+                    file=mod.relpath, line=node.lineno, col=0,
+                    code="KAI104",
+                    message=(f"mutable class attribute on {cqual} is "
+                             f"shared across every instance (and every "
+                             f"thread touching any instance) — assign "
+                             f"it in __init__ or use "
+                             f"dataclasses.field(default_factory=...)"),
+                    function=cqual)
+
+
+# ---------------------------------------------------------------------------
+# rule registration — the KAI1xx catalog entries.
+#
+# The checks themselves are graph-level (the engine invokes
+# ``analyze_package`` once per lint run, not per module), so the
+# registered check functions are inert; registration carries the
+# titles for --list-rules/--select and the per-rule self-test fixtures
+# ``tests/test_analysis.py`` exercises through ``lint_source``.
+# ---------------------------------------------------------------------------
+
+
+def _graph_level(ctx: RuleCtx) -> Iterator[Finding]:
+    return iter(())
+
+
+rule("KAI100", "stale kai-race annotation (guarded-by comment with no "
+     "live shared state)",
+     bad="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0  # kai-race: guarded-by=_lock
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        pass
+""",
+     good="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # kai-race: guarded-by=_lock
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+""")(_graph_level)
+
+
+rule("KAI101", "unguarded write to state shared across thread contexts",
+     bad="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.count += 1
+
+    def snapshot(self):
+        return self.count
+""",
+     good="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+""")(_graph_level)
+
+
+rule("KAI102", "mixed guarded/unguarded access (or a declared "
+     "guarded-by discipline violated)",
+     bad="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.state["k"] = 1
+
+    def peek(self):
+        return self.state.get("k")
+""",
+     good="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}  # kai-race: guarded-by=atomic-swap
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.state = {"k": 1}
+
+    def peek(self):
+        return self.state.get("k")
+""")(_graph_level)
+
+
+rule("KAI103", "inconsistent lock acquisition order across paths "
+     "(deadlock window)",
+     bad="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        threading.Thread(target=self.one, daemon=True).start()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+""",
+     good="""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        threading.Thread(target=self.one, daemon=True).start()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+""")(_graph_level)
+
+
+rule("KAI104", "mutable class attribute shared across instances",
+     bad="""
+class Pool:
+    workers = []
+
+    def add(self, w):
+        self.workers.append(w)
+""",
+     good="""
+class Pool:
+    def __init__(self):
+        self.workers = []
+
+    def add(self, w):
+        self.workers.append(w)
+""")(_graph_level)
+
+
+rule("KAI105", "blocking call (I/O, sleep, device sync) while holding "
+     "a lock",
+     bad="""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            time.sleep(1.0)
+""",
+     good="""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        time.sleep(1.0)
+        with self._lock:
+            self.n += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.n
+""")(_graph_level)

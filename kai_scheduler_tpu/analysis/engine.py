@@ -77,6 +77,7 @@ def rule(code: str, title: str, *, bad: str = "", good: str = ""):
 
 def rule_catalog() -> dict[str, str]:
     """code -> title, for --list-rules and the docs."""
+    from . import concurrency as _conc  # noqa: F401  (registers on import)
     from . import rules as _rules  # noqa: F401  (registers on import)
     return {c: RULES[c].title for c in sorted(RULES)}
 
@@ -125,6 +126,9 @@ class LintResult:
     #: raw finding count before suppressions/baseline (telemetry)
     raw_count: int
     baselined: int = 0
+    #: the kai-race layer's report (thread roots, disciplines) when the
+    #: KAI1xx family ran — see ``concurrency.py``
+    race: "object" = None
 
 
 def _suppressions(source: str) -> dict[int, set[str]]:
@@ -203,6 +207,7 @@ def _apply_baseline(findings: list[Finding],
 def _lint_module(mod: ModuleInfo, jit_quals: set[str],
                  select: Iterable[str] | None,
                  f64_allowlist: frozenset[str]) -> list[Finding]:
+    from . import concurrency as _conc  # noqa: F401  (registers on import)
     from . import rules as _rules  # noqa: F401  (registers on import)
     ctx = RuleCtx(mod=mod, jit_quals=jit_quals,
                   f64_allowlist=f64_allowlist)
@@ -214,14 +219,44 @@ def _lint_module(mod: ModuleInfo, jit_quals: set[str],
     return out
 
 
+def _race_by_module(graph: PackageGraph,
+                    select: set[str] | None,
+                    guarded_map: dict | None):
+    """Run the graph-level kai-race pass (``concurrency.py``) and group
+    its findings per module so suppressions apply alongside the
+    per-module rules.  Returns ``(findings by modname, RaceReport)``;
+    the pass is skipped entirely when ``--select`` names no KAI1xx
+    code."""
+    from . import concurrency
+    codes = set(concurrency.race_codes())
+    if select is not None and not (codes & select):
+        return {}, None
+    report = concurrency.analyze_package(
+        graph, concurrency.load_guarded_map()
+        if guarded_map is None else guarded_map)
+    relpath_to_mod = {m.relpath: name
+                      for name, m in graph.modules.items()}
+    by_mod: dict[str, list[Finding]] = {}
+    for f in report.findings:
+        if select is not None and f.code not in select:
+            continue
+        modname = relpath_to_mod.get(f.file)
+        if modname is not None:
+            by_mod.setdefault(modname, []).append(f)
+    return by_mod, report
+
+
 def lint_package(root: str, *, package: str = "kai_scheduler_tpu",
                  select: Iterable[str] | None = None,
                  baseline: list[dict] | None = None,
                  f64_allowlist: frozenset[str] = F64_HOST_ALLOWLIST,
+                 guarded_map: dict | None = None,
                  ) -> LintResult:
-    """Lint every module of ``package`` under repo ``root``."""
+    """Lint every module of ``package`` under repo ``root`` — the
+    per-module KAI0xx rules plus the graph-level KAI1xx race pass."""
     graph = PackageGraph(root, package=package)
     select = set(select) if select is not None else None
+    race_hits, race_report = _race_by_module(graph, select, guarded_map)
     findings: list[Finding] = []
     stale: list[Finding] = []
     raw = 0
@@ -229,6 +264,7 @@ def lint_package(root: str, *, package: str = "kai_scheduler_tpu",
         mod = graph.modules[modname]
         hits = _lint_module(mod, graph.jit_functions(modname), select,
                             f64_allowlist)
+        hits.extend(race_hits.get(modname, ()))
         raw += len(hits)
         kept, dead = _apply_suppressions(mod, hits, select)
         findings.extend(kept)
@@ -239,7 +275,8 @@ def lint_package(root: str, *, package: str = "kai_scheduler_tpu",
         findings, eaten = _apply_baseline(findings, baseline)
     return LintResult(findings=sorted(findings),
                       stale_suppressions=sorted(stale),
-                      raw_count=raw, baselined=eaten)
+                      raw_count=raw, baselined=eaten,
+                      race=race_report)
 
 
 def lint_source(source: str, *, filename: str = "<fixture>.py",
@@ -249,7 +286,8 @@ def lint_source(source: str, *, filename: str = "<fixture>.py",
     """Lint one in-memory module (rule fixtures / editor integration).
 
     The snippet is its own universe: jit entry points declared inside it
-    (``@jax.jit`` etc.) grow its jit region exactly as in a package run.
+    (``@jax.jit`` etc.) grow its jit region exactly as in a package run,
+    and thread spawns inside it seed the kai-race pass the same way.
     """
     graph = PackageGraph.__new__(PackageGraph)
     graph.root = "."
@@ -263,5 +301,7 @@ def lint_source(source: str, *, filename: str = "<fixture>.py",
     select = set(select) if select is not None else None
     hits = _lint_module(mod, graph.jit_functions("fixture"), select,
                         f64_allowlist)
+    race_hits, _report = _race_by_module(graph, select, guarded_map={})
+    hits.extend(race_hits.get("fixture", ()))
     kept, stale = _apply_suppressions(mod, hits, select)
     return sorted(kept + stale)

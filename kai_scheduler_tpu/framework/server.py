@@ -186,12 +186,30 @@ def _commit_doc(result) -> dict:
 
 
 class SchedulerServer:
-    """Serve the debug/sidecar endpoints for one cluster + scheduler."""
+    """Serve the debug/sidecar endpoints for one cluster + scheduler.
+
+    Concurrency model: ``ThreadingHTTPServer`` runs every request in its
+    own thread, so the stored cluster document and the (stateful)
+    Scheduler are shared mutable state.  All handler access to them is
+    serialized under ``_state_lock`` — payloads are computed under the
+    lock and written to the socket after releasing it, so a slow client
+    never stalls the next request's state access.  ``GET /healthz``
+    serves ``_cycle_stats``, an immutable per-cycle stats document
+    swapped (never mutated) after each cycle run through the server.
+    The cluster/scheduler pair handed to a running server is owned by
+    it: driving ``run_once`` on the same objects from another thread
+    bypasses this lock.
+    """
 
     def __init__(self, cluster: Cluster, scheduler: Scheduler | None = None,
                  port: int = 0):
-        self.cluster = cluster
+        self._state_lock = threading.Lock()
+        self.cluster = cluster  # kai-race: guarded-by=_state_lock
         self.scheduler = scheduler or Scheduler()
+        #: immutable per-cycle stats document (GET /healthz); handler
+        #: threads swap in a fresh dict under _state_lock, readers take
+        #: the current binding without it
+        self._cycle_stats: dict | None = None  # kai-race: guarded-by=atomic-swap
         # continuous profiling (the Pyroscope analogue) — created here,
         # STARTED in start() so a never-started server leaks no sampler
         self.profiler = None
@@ -209,43 +227,56 @@ class SchedulerServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, payload, code=200):
-                body = json.dumps(payload).encode()
+            def _send_text(self, body: bytes,
+                           ctype: str = "text/plain",
+                           code: int = 200) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send(self, payload, code=200):
+                self._send_text(json.dumps(payload).encode(),
+                                "application/json", code)
+
             def do_GET(self):  # noqa: N802
+                # cluster/scheduler reads happen under the state lock;
+                # the response is written AFTER release so a slow client
+                # cannot hold every other endpoint hostage
                 if self.path == "/job-order":
-                    self._send(job_order(outer.cluster, outer.scheduler))
+                    with outer._state_lock:
+                        payload = job_order(outer.cluster, outer.scheduler)
+                    self._send(payload)
                 elif self.path == "/snapshot":
-                    self._send(dump_cluster(outer.cluster))
+                    with outer._state_lock:
+                        payload = dump_cluster(outer.cluster)
+                    self._send(payload)
+                elif self.path == "/healthz":
+                    # _cycle_stats is swapped atomically (never mutated
+                    # in place), so this read needs no lock
+                    stats = outer._cycle_stats
+                    self._send({"ok": True, "last_cycle": stats})
                 elif self.path.startswith("/debug/pprof/continuous"):
                     # the continuous-profiling (Pyroscope) analogue:
-                    # retained folded-stack windows
+                    # retained folded-stack windows (profiler state is
+                    # internally locked)
                     if outer.profiler is None:
                         self.send_error(404, "continuous profiler off")
                         return
-                    body = outer.profiler.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_text(outer.profiler.render().encode())
                 elif self.path.startswith("/debug/pprof"):
                     # the --enable-profiler pprof endpoint analogue
-                    self._send(profile_cycle(outer.cluster,
-                                             outer.scheduler))
+                    with outer._state_lock:
+                        payload = profile_cycle(outer.cluster,
+                                                outer.scheduler)
+                    self._send(payload)
                 elif self.path == "/metrics":
-                    body = metrics.registry.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    # Registry.render snapshots each metric under its
+                    # own lock — the text is a consistent point-in-time
+                    # view even while a cycle thread observes
+                    self._send_text(metrics.registry.render().encode(),
+                                    "text/plain; version=0.0.4")
                 else:
                     self.send_error(404)
 
@@ -267,50 +298,73 @@ class SchedulerServer:
                 proto = self.headers.get(
                     "Content-Type", "").startswith("application/x-protobuf")
                 try:
+                    # socket read happens before taking the state lock;
+                    # the reply goes out after releasing it
                     body = self.rfile.read(length)
                     if proto:
                         from ..wire import codec, sidecar_pb2 as pb
                         if self.path == "/cycle":
                             doc = pb.ClusterDoc()
                             doc.ParseFromString(body)
-                            result = outer.scheduler.run_once(
-                                codec.cluster_from_msg(doc))
+                            # deserialize outside the lock (a tens-of-MB
+                            # snapshot must not stall other endpoints)
+                            cycle_cluster = codec.cluster_from_msg(doc)
+                            with outer._state_lock:
+                                result = outer.scheduler.run_once(
+                                    cycle_cluster)
+                                outer._record_cycle(result)
                             self._send_pb(codec.commit_to_msg(result))
                         elif self.path == "/cluster":
                             doc = pb.ClusterDoc()
                             doc.ParseFromString(body)
-                            outer.cluster = codec.cluster_from_msg(doc)
+                            fresh = codec.cluster_from_msg(doc)  # no lock
+                            with outer._state_lock:
+                                outer.cluster = fresh
                             self._send_pb(pb.CommitSet())
                         elif self.path == "/cluster/delta":
                             delta = pb.ClusterDelta()
                             delta.ParseFromString(body)
-                            codec.apply_delta_msg(outer.cluster, delta)
+                            with outer._state_lock:
+                                codec.apply_delta_msg(outer.cluster, delta)
                             self._send_pb(pb.CommitSet())
                         elif self.path == "/cycle/stored":
-                            result = outer.scheduler.run_once(
-                                outer.cluster)
+                            with outer._state_lock:
+                                result = outer.scheduler.run_once(
+                                    outer.cluster)
+                                outer._record_cycle(result)
                             self._send_pb(codec.commit_to_msg(result))
                         else:
                             self.send_error(404)
                         return
                     if self.path == "/cycle":
                         doc = json.loads(body.decode())
-                        self._send(run_cycle_doc(doc, outer.scheduler))
+                        cycle_cluster = load_cluster(doc)
+                        with outer._state_lock:
+                            result = outer.scheduler.run_once(
+                                cycle_cluster)
+                            outer._record_cycle(result)
+                        self._send(_commit_doc(result))
                     elif self.path == "/cluster":
                         # replace the stored cluster (upload once ...)
                         doc = json.loads(body.decode())
-                        outer.cluster = load_cluster(doc)
+                        fresh = load_cluster(doc)
+                        with outer._state_lock:
+                            outer.cluster = fresh
                         self._send({"ok": True})
                     elif self.path == "/cluster/delta":
                         # ... then PATCH deltas instead of re-shipping
                         # the full document every cycle
                         doc = json.loads(body.decode())
-                        apply_cluster_delta(outer.cluster, doc)
+                        with outer._state_lock:
+                            apply_cluster_delta(outer.cluster, doc)
                         self._send({"ok": True})
                     elif self.path == "/cycle/stored":
                         # run a cycle against the stored cluster: the
                         # incremental sidecar protocol's execute step
-                        result = outer.scheduler.run_once(outer.cluster)
+                        with outer._state_lock:
+                            result = outer.scheduler.run_once(
+                                outer.cluster)
+                            outer._record_cycle(result)
                         self._send(_commit_doc(result))
                     else:
                         self.send_error(404)
@@ -323,6 +377,22 @@ class SchedulerServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+
+    def _record_cycle(self, result) -> None:
+        """Swap in a fresh immutable per-cycle stats document (served
+        by ``GET /healthz``).  Called under ``_state_lock``; readers
+        take the current binding without it (atomic-swap discipline —
+        the dict is never mutated after publication)."""
+        prev = self._cycle_stats
+        stats = {"cycles": (prev["cycles"] + 1) if prev else 1}
+        if result is not None:
+            stats.update(
+                open_seconds=result.open_seconds,
+                commit_seconds=result.commit_seconds,
+                total_seconds=result.session_seconds,
+                bind_requests=len(result.bind_requests),
+                evictions=len(result.evictions))
+        self._cycle_stats = stats
 
     def start(self) -> "SchedulerServer":
         self._thread = threading.Thread(

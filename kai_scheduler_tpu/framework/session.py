@@ -83,6 +83,17 @@ def _pack_commit(result: AllocationResult, state: ClusterState,
     return jnp.concatenate(parts)
 
 
+#: fit_reason code → message (ref ``api/unschedule_info.go`` fit errors).
+#: Module-level: a class attribute dict is shared across instances and
+#: every thread touching any of them (KAI104)
+FIT_REASONS = {
+    1: ("no node satisfies the pod requirements "
+        "(resources / selector / taints / affinity)"),
+    2: "an equivalent pod group already failed this cycle",
+    3: "placement attempt failed (capacity or queue gates)",
+}
+
+
 @dataclasses.dataclass
 class SessionConfig:
     """Cycle-level knobs (ref ``conf/scheduler_conf.go`` SchedulerConfiguration)."""
@@ -338,14 +349,6 @@ class Session:
                 for nm, gr, mv in zip(names.tolist(), groups.tolist(),
                                       targets)]
 
-    #: fit_reason code → message (ref ``api/unschedule_info.go`` fit errors)
-    FIT_REASONS = {
-        1: ("no node satisfies the pod requirements "
-            "(resources / selector / taints / affinity)"),
-        2: "an equivalent pod group already failed this cycle",
-        3: "placement attempt failed (capacity or queue gates)",
-    }
-
     def unschedulable_explanations(
             self, result: AllocationResult,
             host: dict | None = None) -> dict[str, str]:
@@ -360,7 +363,7 @@ class Session:
         # touch only failing gangs (O(failed), not O(G) int conversions)
         ng = len(self.index.gang_names)
         for gi in np.nonzero((reasons[:ng] != 0) & ~allocated[:ng])[0]:
-            out[self.index.gang_names[gi]] = self.FIT_REASONS.get(
+            out[self.index.gang_names[gi]] = FIT_REASONS.get(
                 int(reasons[gi]), f"code {int(reasons[gi])}")
         return out
 

@@ -40,12 +40,12 @@ class ContinuousProfiler:
         self.app_name = app_name
         self.retain_windows = retain_windows
         self._lock = threading.Lock()
-        self._current: dict[str, int] = {}
-        self._window_start = time.time()
+        self._current: dict[str, int] = {}  # kai-race: guarded-by=_lock
+        self._window_start = time.time()  # kai-race: guarded-by=_lock
         #: closed windows, newest last: (start_ts, end_ts, folded dict)
-        self.windows: list[tuple[float, float, dict[str, int]]] = []
-        self.pushed = 0
-        self.push_errors = 0
+        self.windows: list[tuple[float, float, dict[str, int]]] = []  # kai-race: guarded-by=_lock
+        self.pushed = 0  # kai-race: guarded-by=_lock
+        self.push_errors = 0  # kai-race: guarded-by=_lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -87,13 +87,18 @@ class ContinuousProfiler:
         try:
             req = urllib.request.Request(url, data=body, method="POST")
             urllib.request.urlopen(req, timeout=2.0).read()
-            self.pushed += 1
+            # counters under the lock: stop()'s final roll can push from
+            # the caller thread while the sampler's own push is in flight
+            with self._lock:
+                self.pushed += 1
         except Exception:  # noqa: BLE001 — profiling must never bite
-            self.push_errors += 1
+            with self._lock:
+                self.push_errors += 1
 
     def _run(self) -> None:
         period = 1.0 / self.sample_hz
-        next_roll = self._window_start + self.window_s
+        with self._lock:
+            next_roll = self._window_start + self.window_s
         while not self._stop.wait(period):
             self._sample_once()
             now = time.time()
@@ -104,21 +109,39 @@ class ContinuousProfiler:
     # -- lifecycle / rendering -------------------------------------------
 
     def start(self) -> "ContinuousProfiler":
-        if self._thread is None:
-            # stop() leaves the event set; without clearing it a
-            # re-started sampler thread would exit immediately and
-            # silently stop profiling
-            self._stop.clear()
+        if self._thread is not None and not self._thread.is_alive():
+            # a previous stop() timed out on join and the straggler has
+            # since exited — safe to forget it and restart
+            self._thread = None
+        if self._thread is not None:
+            if self._stop.is_set():
+                # stop() joined with a timeout and the old sampler is
+                # STILL running; starting another would leak a second
+                # daemon sampler writing into the same windows
+                raise RuntimeError(
+                    "previous sampler thread has not stopped "
+                    "(stop() join timed out) — cannot start a second one")
+            return self  # already running
+        # stop() leaves the event set; without clearing it a re-started
+        # sampler thread would exit immediately and silently stop
+        # profiling
+        self._stop.clear()
+        with self._lock:
             self._window_start = time.time()
-            self._thread = threading.Thread(
-                target=self._run, name="continuous-profiler", daemon=True)
-            self._thread.start()
+        self._thread = threading.Thread(
+            target=self._run, name="continuous-profiler", daemon=True)
+        self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 2.0) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2.0)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # keep the reference: a later start() must refuse to run
+                # a second sampler beside the straggler
+                self._roll_window(time.time())
+                return
             self._thread = None
         self._roll_window(time.time())
 

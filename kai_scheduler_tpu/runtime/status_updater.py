@@ -71,7 +71,8 @@ class AsyncStatusUpdater:
 
     @property
     def applied(self) -> int:
-        return self._applied
+        with self._lock:
+            return self._applied
 
     @property
     def pending(self) -> int:
@@ -94,9 +95,11 @@ class AsyncStatusUpdater:
             try:
                 with self.apply_lock:
                     update.apply()
-                self._applied += 1
+                with self._lock:  # workers race each other on the counters
+                    self._applied += 1
             except Exception:  # noqa: BLE001 — a failed write never
-                self._errors += 1  # stalls the pool (reference logs+drops)
+                with self._lock:  # stalls the pool (reference logs+drops)
+                    self._errors += 1
             finally:
                 with self._lock:
                     self._inflight -= 1

@@ -53,6 +53,7 @@ is element-wise identical — including ``SnapshotIndex`` name maps.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import weakref
 
 import jax
@@ -99,10 +100,11 @@ _CURSOR_FIELDS = ("pods_dirty", "pods_added", "pods_removed",
                   "structural", "time_dirty")
 
 
-class JournalCursor:
-    """One consumer's pending change sets (drained by ``consume``)."""
+class JournalBatch:
+    """One drained window of changes — private to the consumer that
+    drained it (no lock needed to read it)."""
 
-    __slots__ = _CURSOR_FIELDS + ("__weakref__",)
+    __slots__ = _CURSOR_FIELDS
 
     def __init__(self):
         self.pods_dirty: set[str] = set()
@@ -114,12 +116,41 @@ class JournalCursor:
         self.structural: list[str] = []
         self.time_dirty = False
 
-    def consume(self) -> "JournalCursor":
-        """Return the accumulated sets and reset this cursor."""
-        out = JournalCursor()
-        for slot in _CURSOR_FIELDS:
-            setattr(out, slot, getattr(self, slot))
-        self.__init__()
+
+class JournalCursor:
+    """One consumer's pending change sets (drained by ``consume``).
+
+    The cursor shares its journal's lock: marks (any thread — binder,
+    status-updater workers, HTTP handler deltas) and ``consume`` (the
+    snapshotter's refresh) are mutually exclusive, so a drain can never
+    observe a half-recorded mutation or drop a mark that raced the
+    field swap.
+    """
+
+    __slots__ = _CURSOR_FIELDS + ("_lock", "__weakref__")
+
+    def __init__(self, lock: threading.Lock | None = None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self._reset()
+
+    def _reset(self) -> None:
+        self.pods_dirty: set[str] = set()
+        self.pods_added: list[str] = []
+        self.pods_removed: set[str] = set()
+        self.gangs_dirty: set[str] = set()
+        self.gangs_added: list[str] = []
+        self.nodes_dirty: set[str] = set()
+        self.structural: list[str] = []
+        self.time_dirty = False
+
+    def consume(self) -> "JournalBatch":
+        """Move the accumulated sets into a private batch and reset —
+        atomically with respect to concurrent marks."""
+        out = JournalBatch()
+        with self._lock:
+            for slot in _CURSOR_FIELDS:
+                setattr(out, slot, getattr(self, slot))
+            self._reset()
         return out
 
 
@@ -129,15 +160,30 @@ class MutationJournal:
     Marks are cheap set/list inserts; with no cursor registered only the
     generation counter moves.  Consumers (one ``IncrementalSnapshotter``
     each) register a :class:`JournalCursor` and drain it per refresh.
+
+    Thread-safe: marks arrive from the binder, the async status-updater
+    workers, and ThreadingHTTPServer delta handlers while the scheduler
+    thread drains cursors — every mark and every ``consume`` runs under
+    one journal lock (a torn or lost mark would let the snapshotter
+    serve a silently stale patch; see ``tests/test_incremental.py``
+    journal-hammer regression).
     """
 
     def __init__(self):
-        self.generation = 0
+        self._lock = threading.Lock()
+        self.generation = 0  # kai-race: guarded-by=_lock
         self._cursors: list = []  # weakrefs to JournalCursor
 
+    def __deepcopy__(self, memo):
+        # a deep-copied cluster document (profile_cycle's private copy)
+        # starts its own change feed: locks are not copyable, and the
+        # copy's mutations must not dirty the original's consumers
+        return MutationJournal()
+
     def register(self) -> JournalCursor:
-        cur = JournalCursor()
-        self._cursors.append(weakref.ref(cur))
+        cur = JournalCursor(self._lock)
+        with self._lock:
+            self._cursors.append(weakref.ref(cur))
         return cur
 
     def _each(self):
@@ -156,50 +202,58 @@ class MutationJournal:
     # -- marks ------------------------------------------------------------
 
     def mark_pod(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.pods_dirty.add(name)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.pods_dirty.add(name)
 
     def mark_pod_added(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            if name not in c.pods_removed and name not in c.pods_dirty:
-                c.pods_added.append(name)
-            else:
-                # removed-then-readded (or dirtied) inside one window:
-                # position in the dict may have moved — too subtle to
-                # patch, let the sweep/full rebuild sort it out
-                c.structural.append("pod-readded")
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                if name not in c.pods_removed and name not in c.pods_dirty:
+                    c.pods_added.append(name)
+                else:
+                    # removed-then-readded (or dirtied) inside one window:
+                    # position in the dict may have moved — too subtle to
+                    # patch, let the sweep/full rebuild sort it out
+                    c.structural.append("pod-readded")
 
     def mark_pod_removed(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.pods_removed.add(name)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.pods_removed.add(name)
 
     def mark_gang(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.gangs_dirty.add(name)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.gangs_dirty.add(name)
 
     def mark_gang_added(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.gangs_added.append(name)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.gangs_added.append(name)
 
     def mark_node(self, name: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.nodes_dirty.add(name)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.nodes_dirty.add(name)
 
     def mark_structural(self, reason: str) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.structural.append(reason)
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.structural.append(reason)
 
     def mark_time(self) -> None:
-        self.generation += 1
-        for c in self._each():
-            c.time_dirty = True
+        with self._lock:
+            self.generation += 1
+            for c in self._each():
+                c.time_dirty = True
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,7 @@ class _Metric:
 class Counter(_Metric):
     def __init__(self, name, help="", label_names=()):
         super().__init__(name, help, tuple(label_names))
-        self._values: dict[tuple[str, ...], float] = {}
+        self._values: dict[tuple[str, ...], float] = {}  # kai-race: guarded-by=_lock
         self._lock = threading.Lock()
 
     def inc(self, *labels: str, by: float = 1.0) -> None:
@@ -39,15 +39,23 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + by
 
     def value(self, *labels: str) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
 
     def render(self) -> list[str]:
-        return _render_simple(self, "counter", self._values)
+        # render from an immutable copy: a /metrics scrape thread must
+        # not iterate a dict the cycle thread is growing
+        with self._lock:
+            values = dict(self._values)
+        return _render_simple(self, "counter", values)
 
 
 class Gauge(_Metric):
     def __init__(self, name, help="", label_names=()):
         super().__init__(name, help, tuple(label_names))
+        # discipline declared in analysis/guarded_by.json (the cycle's
+        # gauge updates go through loop variables the static pass
+        # cannot type, so an inline annotation would read as stale)
         self._values: dict[tuple[str, ...], float] = {}
         self._lock = threading.Lock()
 
@@ -56,10 +64,13 @@ class Gauge(_Metric):
             self._values[self._key(labels)] = float(value)
 
     def value(self, *labels: str) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
 
     def render(self) -> list[str]:
-        return _render_simple(self, "gauge", self._values)
+        with self._lock:
+            values = dict(self._values)
+        return _render_simple(self, "gauge", values)
 
 
 _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
@@ -71,8 +82,8 @@ class Histogram(_Metric):
                  buckets=_DEFAULT_BUCKETS):
         super().__init__(name, help, tuple(label_names))
         self.buckets = tuple(sorted(buckets))
-        self._counts: dict[tuple[str, ...], list[int]] = {}
-        self._sums: dict[tuple[str, ...], float] = {}
+        self._counts: dict[tuple[str, ...], list[int]] = {}  # kai-race: guarded-by=_lock
+        self._sums: dict[tuple[str, ...], float] = {}  # kai-race: guarded-by=_lock
         self._lock = threading.Lock()
 
     def observe(self, *labels: str, value: float) -> None:
@@ -84,12 +95,18 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
 
     def count(self, *labels: str) -> int:
-        return sum(self._counts.get(self._key(labels), []))
+        with self._lock:
+            return sum(self._counts.get(self._key(labels), []))
 
     def render(self) -> list[str]:
+        # snapshot under the lock (bucket lists mutate in place), render
+        # from the copy
+        with self._lock:
+            counts_copy = {k: list(v) for k, v in self._counts.items()}
+            sums_copy = dict(self._sums)
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
-        for key, counts in sorted(self._counts.items()):
+        for key, counts in sorted(counts_copy.items()):
             cum = 0
             for le, c in zip(self.buckets, counts):
                 cum += c
@@ -99,7 +116,7 @@ class Histogram(_Metric):
             lines.append(
                 f'{self.name}_bucket{_labels(self, key, le="+Inf")} {cum}')
             lines.append(f"{self.name}_sum{_labels(self, key)} "
-                         f"{self._sums[key]}")
+                         f"{sums_copy[key]}")
             lines.append(f"{self.name}_count{_labels(self, key)} {cum}")
         return lines
 
@@ -122,29 +139,41 @@ def _render_simple(metric: _Metric, kind: str, values: dict) -> list[str]:
 
 
 class Registry:
-    """A metric collection with text exposition."""
+    """A metric collection with text exposition.
+
+    Render is safe against concurrent registration and observation: the
+    metric list is copied under the registry lock and each metric
+    renders from a copy taken under its own lock, so the text a scrape
+    thread sees is an immutable point-in-time snapshot.
+    """
 
     def __init__(self):
-        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+        self._metrics: list[_Metric] = []  # kai-race: guarded-by=_lock
 
     def counter(self, name, help="", label_names=()) -> Counter:
         m = Counter(name, help, label_names)
-        self._metrics.append(m)
+        with self._lock:
+            self._metrics.append(m)
         return m
 
     def gauge(self, name, help="", label_names=()) -> Gauge:
         m = Gauge(name, help, label_names)
-        self._metrics.append(m)
+        with self._lock:
+            self._metrics.append(m)
         return m
 
     def histogram(self, name, help="", label_names=(),
                   buckets=_DEFAULT_BUCKETS) -> Histogram:
         m = Histogram(name, help, label_names, buckets)
-        self._metrics.append(m)
+        with self._lock:
+            self._metrics.append(m)
         return m
 
     def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
         lines: list[str] = []
-        for m in self._metrics:
+        for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"

@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Thin kai-lint wrapper for local / pre-commit use.
 
-Runs the AST layer only (no jax import — sub-second), exits nonzero on
-any new finding:
+Runs the AST layers only — the KAI0xx trace-safety rules AND the
+KAI1xx kai-race concurrency pass (both pure AST, no jax import) — and
+exits nonzero on any new finding:
 
-    python scripts/lint.py             # lint the repo
+    python scripts/lint.py             # lint the repo (incl. kai-race)
     python scripts/lint.py --json      # machine-readable
     python scripts/lint.py --select KAI041,KAI052
+    python scripts/lint.py --select KAI101,KAI102,KAI105  # race only
 
 Hook it up with::
 

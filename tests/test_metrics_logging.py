@@ -54,3 +54,39 @@ def test_infra_logger_verbosity_and_scope(capsys):
     assert "placed 5 pods" in err
     assert "session=7" in err and "action=allocate" in err
     assert "should not appear" not in err
+
+
+def test_render_consistent_under_concurrent_observation():
+    """A /metrics scrape renders while the cycle thread observes.
+    Pre-PR-4 the histogram renderer iterated the LIVE bucket lists and
+    read ``_sums`` afterwards, so a scrape overlapping observes could
+    expose sum != count * value — a torn, never-was state.  The locked
+    snapshot pins sum == count exactly (every observed value is 1.0)."""
+    import threading
+
+    reg = Registry()
+    hist = reg.histogram("h_seconds", "h", buckets=(0.5, 2.0))
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            hist.observe(value=1.0)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    torn = []
+    try:
+        for _ in range(400):
+            text = reg.render()
+            got_sum = got_count = None
+            for line in text.splitlines():
+                if line.startswith("h_seconds_sum"):
+                    got_sum = float(line.rsplit(" ", 1)[1])
+                elif line.startswith("h_seconds_count"):
+                    got_count = float(line.rsplit(" ", 1)[1])
+            if got_sum is not None and got_sum != got_count:
+                torn.append((got_sum, got_count))
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not torn, f"torn expositions: {torn[:3]}"

@@ -177,3 +177,112 @@ class TestContinuousProfiler:
             assert doc["profilerSampleHz"] == 100.0
         finally:
             server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Concurrency (PR 4): serialized handler state access, the /healthz
+# per-cycle stats snapshot, and the profiler stop/start lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_serves_swapped_cycle_stats():
+    server = SchedulerServer(_cluster()).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        doc = json.load(urllib.request.urlopen(f"{base}/healthz"))
+        assert doc["ok"] is True and doc["last_cycle"] is None
+        req = urllib.request.Request(
+            f"{base}/cycle/stored", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+        doc = json.load(urllib.request.urlopen(f"{base}/healthz"))
+        stats = doc["last_cycle"]
+        assert stats["cycles"] == 1
+        assert stats["bind_requests"] == 8
+        assert stats["total_seconds"] >= 0.0
+    finally:
+        server.stop()
+
+
+def test_concurrent_deltas_and_reads_stay_consistent():
+    """ThreadingHTTPServer runs handlers on per-request threads; deltas
+    mutating the stored cluster must serialize against snapshot/metrics
+    reads instead of tearing the document (pre-PR-4 a delta could
+    resize dicts mid-GET)."""
+    import concurrent.futures
+
+    server = SchedulerServer(_cluster()).start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post_delta(i):
+        body = json.dumps({"pods_upsert": [{
+            "name": f"stress-{i}", "group": "gang-0"}]}).encode()
+        req = urllib.request.Request(
+            f"{base}/cluster/delta", data=body,
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10).status
+
+    def get_snapshot(_i):
+        snap = json.load(urllib.request.urlopen(
+            f"{base}/snapshot", timeout=10))
+        # a torn document would lose invariants like this one
+        assert {"nodes", "pods", "pod_groups"} <= set(snap)
+        return 200
+
+    def get_metrics(_i):
+        urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        return 200
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futures = []
+            for i in range(12):
+                futures.append(pool.submit(post_delta, i))
+                futures.append(pool.submit(get_snapshot, i))
+                futures.append(pool.submit(get_metrics, i))
+            statuses = [f.result() for f in futures]
+        assert all(s == 200 for s in statuses)
+        # every delta landed exactly once
+        snap = json.load(urllib.request.urlopen(f"{base}/snapshot"))
+        names = {p["name"] for p in snap["pods"]}
+        assert {f"stress-{i}" for i in range(12)} <= names
+    finally:
+        server.stop()
+
+
+def test_profiler_second_start_after_failed_join_raises():
+    """stop() joins with a timeout; if the sampler refuses to die, a
+    second start() must raise instead of leaking a second daemon
+    sampler writing into the same windows (PR-4 satellite)."""
+    import threading
+    import time as _t
+
+    import pytest as _pytest
+
+    from kai_scheduler_tpu.runtime.profiling import ContinuousProfiler
+
+    prof = ContinuousProfiler(sample_hz=50, window_s=10.0)
+    release = threading.Event()
+
+    class _Stubborn(threading.Thread):
+        """Stands in for a wedged sampler: ignores the stop event until
+        released."""
+
+        def run(self):
+            release.wait(10.0)
+
+    stub = _Stubborn(daemon=True)
+    stub.start()
+    prof._thread = stub
+    prof.stop(timeout=0.05)  # join times out — sampler still alive
+    assert prof._thread is stub  # the straggler is NOT forgotten
+    with _pytest.raises(RuntimeError, match="has not stopped"):
+        prof.start()
+    release.set()
+    stub.join(timeout=5)
+    # once the straggler exits, start() recovers cleanly
+    prof.start()
+    _t.sleep(0.05)
+    assert prof._thread is not None and prof._thread.is_alive()
+    prof.stop()
+    assert prof._thread is None
