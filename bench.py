@@ -12,6 +12,9 @@ pending pods**, p99 cycle latency against the driver's 50 ms bar
   3 gang        2k nodes, 1k gangs × 8 pods (all-or-nothing)
   4 topology    5k nodes, 3-level tree, rack-constrained gangs
   5 reclaim     10k nodes × 50k pods, over-quota victim search
+  preempt       512 queues × 1 boosted preemptor @ 10k nodes (the
+                sparse victim-wavefront hot path; quick alias of
+                preempt_many_queues)
   headline      10k nodes × 50k pods allocate
   e2e/e2e_alloc full cycle (snapshot→actions→commit), saturated /
                 allocate-heavy shapes
@@ -611,6 +614,10 @@ CONFIGS = {
     "3": bench_gang, "gang": bench_gang,
     "4": bench_topology, "topology": bench_topology,
     "5": bench_reclaim, "reclaim": bench_reclaim,
+    # quick single-config target for the victim-wavefront hot path
+    # (BENCH_CONFIG=preempt — same config as the full artifact's
+    # preempt_many_queues row)
+    "preempt": bench_preempt_many_queues,
     "preempt_many_queues": bench_preempt_many_queues,
     "churn": bench_churn,
     "headline": bench_headline,
@@ -636,10 +643,12 @@ def _compare(cur: dict, prev_path: str) -> dict:
     pe, ce = prev.get("extra", {}), cur.get("extra", {})
     single = os.environ.get("BENCH_CONFIG")
     if single in ("fairshare", "scoring", "gang", "topology", "reclaim",
+                  "preempt", "preempt_many_queues", "churn",
                   "1", "2", "3", "4", "5"):
         # single-config run: compare ONLY against the matching prev row
         names = {"1": "fairshare", "2": "scoring", "3": "gang",
-                 "4": "topology", "5": "reclaim"}
+                 "4": "topology", "5": "reclaim",
+                 "preempt": "preempt_many_queues"}
         name = names.get(single, single)
         return_rows = {name: (pe.get(name, {}).get("p99_ms"),
                               cur.get("value"))}

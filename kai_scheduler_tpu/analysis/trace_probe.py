@@ -54,6 +54,14 @@ _CUMSUM_JIT = jax.jit(numerics.cumsum_ds)
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
 
+#: the Session-auto-tuned shape that engages the sparse preempt
+#: wavefront (``ops/victims._sparse_preempt_ok``) — the canonical
+#: cluster is uniform/no-fraction, so this mirrors what production
+#: would compile for it
+_VCFG_SPARSE = VictimConfig(placement=AllocateConfig(
+    dynamic_order=False, track_devices=False, uniform_tasks=True,
+    subgroup_topology=False, extended=False))
+
 #: primitive names that must never appear in a cycle kernel's jaxpr
 FORBIDDEN_PRIMITIVES = frozenset({
     "pure_callback", "io_callback", "debug_callback", "callback",
@@ -162,6 +170,19 @@ def _registry() -> list[ProbeSpec]:
                                          config=vcfg)), m=mode))
             for mode in ("reclaim", "preempt", "consolidate")
         ],
+        ProbeSpec(
+            # the sparse/optimistic preempt wavefront (ops/victims.py):
+            # same jit entry point, but the sparse protocol only traces
+            # under the uniform/no-device/no-extended/no-subgroup shape
+            # the Session auto-tunes to — probed explicitly so its
+            # jaxpr stays under the callback/f64/eqn budgets too
+            "victims_preempt_sparse",
+            functools.partial(run_victim_action, num_levels=nl,
+                              mode="preempt", config=_VCFG_SPARSE),
+            run_victim_action_jit,
+            lambda env: (victim_args(env, "preempt")[0],
+                         dict(num_levels=nl, mode="preempt",
+                              config=_VCFG_SPARSE))),
         ProbeSpec(
             "stale_gang_eviction",
             functools.partial(stale_gang_eviction,

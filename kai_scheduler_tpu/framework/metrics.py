@@ -42,3 +42,25 @@ queue_allocated = registry.gauge(
 queue_usage = registry.gauge(
     "kai_queue_usage", "Per-queue normalized historical usage",
     label_names=("queue", "resource"))
+# victim-wavefront observability (ops/victims.py chunked engine): chunk
+# count and lane occupancy per action per cycle, plus how often the
+# sparse preempt path fell back to the dense composed path (compact
+# unit-table overflow)
+victim_wavefront_chunks = registry.gauge(
+    "kai_victim_wavefront_chunks",
+    "Victim-wavefront chunks run last cycle", label_names=("action",))
+victim_wavefront_lane_occupancy = registry.gauge(
+    "kai_victim_wavefront_lane_occupancy",
+    "Live lanes / lane slots across last cycle's victim chunks",
+    label_names=("action",))
+victim_wavefront_sparse_fallbacks = registry.gauge(
+    "kai_victim_wavefront_sparse_fallbacks",
+    "Sparse-path actions that fell back to the dense composed path "
+    "last cycle", label_names=("action",))
+victim_wavefront_leftover_demotions = registry.gauge(
+    "kai_victim_wavefront_leftover_demotions",
+    "Lane-chunk demotion events last cycle (a lane demoted to "
+    "conflict-retry because an earlier lane's victims freed more than "
+    "its claims consumed; the same lane re-demoted in a later chunk "
+    "counts again — the gauge measures serialization pressure, not "
+    "distinct lanes)", label_names=("action",))

@@ -428,6 +428,24 @@ class Scheduler:
             by=float(host["attempted"].sum()))
         metrics.podgroups_scheduled.inc(
             "all", by=float(host["allocated"].sum()))
+        # victim-wavefront counters ride the packed commit transfer
+        # (AllocationResult.wavefront_stats): per action, chunk count,
+        # lane occupancy, and sparse→dense fallbacks of this cycle
+        ws = host.get("wavefront_stats")
+        if ws is not None:
+            for row, action in ((0, "reclaim"), (1, "preempt")):
+                chunks, live, slots, fb, demo = (int(x) for x in ws[row])
+                metrics.victim_wavefront_chunks.set(
+                    action, value=float(chunks))
+                metrics.victim_wavefront_lane_occupancy.set(
+                    action, value=(live / slots) if slots else 0.0)
+                if action == "preempt":
+                    # reclaim has no sparse path or leftover demotion,
+                    # so no fallback/demotion series
+                    metrics.victim_wavefront_sparse_fallbacks.set(
+                        action, value=float(fb))
+                    metrics.victim_wavefront_leftover_demotions.set(
+                        action, value=float(demo))
         # arrays come from the cycle's single batched transfer; change
         # detection is VECTORIZED against the previous cycle's tables so
         # the Python loop touches only cells that moved — O(changed)

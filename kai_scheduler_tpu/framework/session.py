@@ -27,7 +27,8 @@ from ..apis import types as apis
 from ..ops import drf
 from ..ops.allocate import AllocateConfig, AllocationResult
 from ..ops.victims import VictimConfig
-from ..state.cluster_state import ClusterState, SnapshotIndex, build_snapshot
+from ..state.cluster_state import (ClusterState, SnapshotIndex,
+                                   _pow2_ceil, build_snapshot)
 
 #: ``set_fair_share`` must run compiled: eagerly, the vmapped waterfill
 #: while_loop re-traces (and recompiles) every cycle — measured ~2.5 s per
@@ -76,11 +77,60 @@ def _pack_commit(result: AllocationResult, state: ClusterState,
         jax.lax.bitcast_convert_type(
             result.queue_allocated, jnp.int16).ravel(),
         jax.lax.bitcast_convert_type(q.fair_share, jnp.int16).ravel(),
+        jax.lax.bitcast_convert_type(
+            result.wavefront_stats, jnp.int16).ravel(),
     ]
     if track_devices:
         parts.append(
             (result.placement_device + 1).ravel().astype(jnp.int16))
     return jnp.concatenate(parts)
+
+
+def _pow4_ceil(x: int) -> int:
+    b = 1
+    while b < int(x):
+        b <<= 2
+    return b
+
+
+def _preempt_lane_width(batch_size: int, num_pending: int,
+                        num_leaf_queues: int, padded_nodes: int) -> int:
+    """Victim-wavefront lane width for preempt (auto-tuning v2).
+
+    The chunk wants one lane per live preemptor up to a memory bound:
+    every lane carries [N, R]-sized freed/score tensors through the
+    placement vmap, so width is capped where B·N crosses ~4M elements
+    (≈50 MB of f32 per per-lane tensor at R=3).  The final width is
+    clamped to the snapshot's pending-gang count — junk lanes past the
+    live preemptor spread pay full freed-pool cost for nothing.
+
+    The width is a STATIC jit arg, so every distinct value compiles
+    the victim kernels once: the spread buckets to powers of FOUR
+    ({1, 4, 16, 64, 256} before the cap) so a cluster whose pending
+    count wanders across cycles settles into a handful of compiled
+    configs, at the price of ≤4x junk lanes at the narrow end where
+    lanes are cheapest.  The memory cap itself halves in powers of TWO
+    (512→256→128→64), so a node count crossing the B·N bound can add
+    one off-bucket width (e.g. 128) to the compiled set."""
+    cap = 512
+    while cap > 64 and cap * max(padded_nodes, 1) > (1 << 22):
+        cap //= 2
+    if num_pending < 0:
+        # hint unavailable (hand-built index): leaf-queue heuristic
+        spread = num_leaf_queues if num_leaf_queues > 64 else batch_size
+    else:
+        spread = max(num_pending, 1)
+    return max(1, min(cap, _pow4_ceil(spread)))
+
+
+def _sparse_unit_width(padded_pods: int, num_leaf_queues: int) -> int:
+    """Compact victim-table width when ``VictimConfig.sparse_unit_k``
+    is None (auto): a few multiples of the mean running-pod count per
+    leaf queue, pow2-bucketed, floored at 256 so sparsely-populated
+    snapshots never shrink below a useful table.  An explicitly-set
+    ``sparse_unit_k`` bypasses this entirely."""
+    per_leaf = padded_pods // max(num_leaf_queues, 1)
+    return max(256, min(1024, _pow2_ceil(4 * max(per_leaf, 1))))
 
 
 #: fit_reason code → message (ref ``api/unschedule_info.go`` fit errors).
@@ -178,12 +228,24 @@ class Session:
                 victims=dataclasses.replace(
                     config.victims,
                     chunk_reclaim=not index.has_reclaim_minruntime,
-                    # preemptors spread over many queues want chunks at
-                    # least that wide (see VictimConfig.batch_size_preempt)
+                    # auto-tuning v2: lane width follows the snapshot's
+                    # live preemptor spread (clamped so junk lanes past
+                    # the pending-gang count stop paying freed-pool
+                    # cost) under a padded-node-count memory bound; the
+                    # compact victim-table width follows running-pod
+                    # density per leaf queue (see VictimConfig)
                     batch_size_preempt=(
-                        256 if index.num_leaf_queues > 64
-                        and config.victims.batch_size_preempt is None
+                        _preempt_lane_width(
+                            config.victims.batch_size,
+                            index.num_pending_gangs,
+                            index.num_leaf_queues, state.nodes.n)
+                        if config.victims.batch_size_preempt is None
                         else config.victims.batch_size_preempt),
+                    sparse_unit_k=(
+                        _sparse_unit_width(
+                            state.running.m, index.num_leaf_queues)
+                        if config.victims.sparse_unit_k is None
+                        else config.victims.sparse_unit_k),
                     placement=dataclasses.replace(
                         config.victims.placement, track_devices=devices,
                         uniform_tasks=uniform, subgroup_topology=sub_topo,
@@ -238,6 +300,8 @@ class Session:
             take(Q * R_ * 2).tobytes(), np.float32).reshape(Q, R_)
         out["fair_share"] = np.frombuffer(
             take(Q * R_ * 2).tobytes(), np.float32).reshape(Q, R_)
+        out["wavefront_stats"] = np.frombuffer(
+            take(2 * 5 * 2).tobytes(), np.int32).reshape(2, 5)
         if devices:
             out["placement_device"] = (take(G * T).astype(np.int32) - 1
                                        ).reshape(G, T)

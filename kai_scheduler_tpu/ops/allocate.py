@@ -108,6 +108,14 @@ class AllocationResult(struct.PyTreeNode):
     #: wavefronts), so a reclaim-placed preemptor excludes later
     #: conflicting placements within the same cycle.
     anti_used: jax.Array
+    #: victim-wavefront observability counters — i32 [2, 5]: row 0 =
+    #: reclaim, row 1 = preempt; cols = (chunks run, live lanes seen,
+    #: lane slots offered, dense-fallback count of the sparse preempt
+    #: path, lane-chunk demotion events from earlier lanes' net
+    #: leftover freed capacity).  Rides the packed commit transfer and
+    #: feeds the ``kai_victim_wavefront_*`` gauges
+    #: (``framework/metrics.py``).
+    wavefront_stats: jax.Array
 
 
 def init_result(state: ClusterState) -> AllocationResult:
@@ -118,6 +126,7 @@ def init_result(state: ClusterState) -> AllocationResult:
     AD = n.n * n.topology.shape[1] + n.n
     return AllocationResult(
         anti_used=jnp.zeros((TA + 1, AD + 1), bool),
+        wavefront_stats=jnp.zeros((2, 5), jnp.int32),
         placements=jnp.full((G, T), -1, jnp.int32),
         extended_free=n.extended_free,
         placement_device=jnp.full((G, T), -1, jnp.int32),
@@ -268,6 +277,81 @@ def attract_defer_lanes(state: ClusterState, cand_g: jax.Array,
     earlier = jnp.arange(B)[None, :] < jnp.arange(B)[:, None]
     return jnp.any(inter & earlier & cand_valid[None, :], axis=1) \
         & cand_valid
+
+
+def sparse_entry_tables(nodes_b: jax.Array, ent_ok: jax.Array, N: int):
+    """Node-sorted view of a wavefront chunk's K = B*T sparse placement
+    entries — the shared core of the sparse accept-prefix protocol
+    (lanes emit placements only; the chunk verifies composed capacity on
+    per-entry claims instead of dense [B, N, R] delta cumsums).
+
+    Entries are generated lane-major and sorted stably by node, so
+    within a node they stay in lane order and a per-node inclusive
+    cumulative claim is exactly the composed demand of lanes ``<= b``.
+    Used by the allocate chunk and the victim wavefront's sparse accept.
+
+    Returns (node_e [K] unsorted node per entry with ``N`` as junk,
+    lane_e [K] unsorted lane per entry, perm [K] the stable node sort,
+    ns [K] sorted nodes, lane_s [K] sorted lanes, sidx [K] index of each
+    sorted entry's node-segment start, ok_s [K] sorted entry validity).
+    """
+    B, T = nodes_b.shape
+    node_e = jnp.where(ent_ok, nodes_b, N).ravel()             # [K]
+    lane_e = jnp.broadcast_to(
+        jnp.arange(B)[:, None], (B, T)).ravel()
+    perm = jnp.argsort(node_e, stable=True)
+    ns = node_e[perm]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), ns[1:] != ns[:-1]])
+    sidx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(ns.shape[0]), -1))
+    return node_e, lane_e, perm, ns, lane_e[perm], sidx, \
+        ent_ok.ravel()[perm]
+
+
+def sparse_accept_first_bad(nodes_b: jax.Array, ent_ok: jax.Array,
+                            pipe_b: jax.Array, req_b: jax.Array,
+                            free: jax.Array, pipe_pool: jax.Array,
+                            N: int, credit=None):
+    """First lane whose sparse claim entries over-subscribe a node pool
+    — THE accept protocol, shared by the allocate chunk and the victim
+    wavefront's sparse path (one implementation so a tolerance or
+    side= change cannot silently diverge the two).
+
+    Claims sort by node via ``sparse_entry_tables``; each entry's
+    node-cumulative demand must fit ``pipe_pool`` (chunk-start free +
+    releasing + extra), and the bind-now subset (claims with
+    ``~pipe_b``) must collectively fit the chunk-start *idle* pool —
+    pipelined flags were derived against chunk-start free, so without
+    the second test a later lane could bind immediately onto capacity
+    another lane just consumed.  ``credit`` optionally maps
+    (lane_s [K], nsafe [K]) to per-entry [K, R] extra capacity granted
+    to later lanes (the victim path's lane-prefix freed deltas
+    gathered at the claim sites).
+
+    Returns (first_bad lane id — B when every claim fits, node_e [K],
+    lane_e [K]: the unsorted entry tables the commit reconstruction
+    reuses).
+    """
+    B = nodes_b.shape[0]
+    node_e, lane_e, perm, ns, lane_s, sidx, ok_s = \
+        sparse_entry_tables(nodes_b, ent_ok, N)
+    req_s = jnp.where(ok_s[:, None], req_b[lane_s], 0.0)      # [K, R]
+    cs = jnp.cumsum(req_s, axis=0)
+    cum_e = cs - (cs - req_s)[sidx]           # inclusive, per node
+    nsafe = jnp.minimum(ns, N - 1)
+    real = ns < N
+    cap_pipe = pipe_pool[nsafe]
+    if credit is not None:
+        cap_pipe = cap_pipe + credit(lane_s, nsafe)
+    viol = jnp.any(cum_e > cap_pipe + EPS, -1) & real
+    bind_e = (ent_ok & ~pipe_b).ravel()[perm]
+    reqb_s = jnp.where(bind_e[:, None], req_b[lane_s], 0.0)
+    csb = jnp.cumsum(reqb_s, axis=0)
+    cumb_e = csb - (csb - reqb_s)[sidx]
+    cap_bind = jnp.maximum(free, 0.0)[nsafe] + EPS
+    viol = viol | (jnp.any(cumb_e > cap_bind, -1) & real)
+    return jnp.min(jnp.where(viol, lane_s, B)), node_e, lane_e
 
 
 def _replica_count(avail: jax.Array, req: jax.Array,
@@ -1069,18 +1153,26 @@ def _attempt_gang_in_domain_uniform(
     placed_sorted = jnp.clip(want - (cum - c_sorted), 0, c_sorted)
     total_placed = jnp.minimum(cum[-1], want)
 
-    # new placements land in the first `total_placed` still-unplaced slots
+    placed_per_node = jnp.zeros((N,), jnp.int32).at[order].add(placed_sorted)
+    # new placements land in the first `total_placed` still-unplaced
+    # slots, taking their chosen nodes in ASCENDING NODE ORDER: uniform
+    # replicas are interchangeable, so the replica->node bijection is a
+    # free choice — canonicalizing it on node id (instead of the score
+    # order, whose ties cascade from earlier placements' density/
+    # availability deltas) keeps the per-task cells bit-identical
+    # between the sequential scan and the victim wavefront whenever
+    # both pick the same node multiset, and makes binds deterministic
+    # under score-input drift generally
+    cum_n = jnp.cumsum(placed_per_node)                 # [N]
     elig_rank = jnp.cumsum((task_valid & ~already).astype(jnp.int32)) - 1
     npos = jnp.where(task_valid & ~already, elig_rank, T)   # [T]
-    sidx = jnp.searchsorted(cum, npos, side="right")    # [T]
-    sidx = jnp.minimum(sidx, k - 1)
+    nidx = jnp.minimum(jnp.searchsorted(cum_n, npos, side="right"),
+                       N - 1)                           # [T] node id
     placed_t = task_valid & ~already & (npos < total_placed)
-    nodes_t = jnp.where(placed_t, order[sidx], -1)
+    nodes_t = jnp.where(placed_t, nidx, -1)
     # within a node the first c_idle replicas bind now, the rest pipeline
-    rank_in_node = npos - (cum[sidx] - c_sorted[sidx])
-    pipe_t = placed_t & (rank_in_node >= c_idle[order[sidx]])
-
-    placed_per_node = jnp.zeros((N,), jnp.int32).at[order].add(placed_sorted)
+    rank_in_node = npos - (cum_n[nidx] - placed_per_node[nidx])
+    pipe_t = placed_t & (rank_in_node >= c_idle[nidx])
     free2 = free - placed_per_node[:, None].astype(free.dtype) * req[None, :]
     # replicas past a node's idle headroom pipeline; the rest bind now
     bind_per_node = jnp.minimum(placed_per_node, c_idle)
@@ -1630,35 +1722,9 @@ def allocate(
             # cumulative claim overruns a node pool bounds the prefix.
             req_b = g.task_req[jnp.minimum(cand, G - 1), 0]       # [B, R]
             ent_ok = succ_b[:, None] & (nodes_b >= 0)             # [B, T]
-            node_e = jnp.where(ent_ok, nodes_b, n.n).ravel()      # [K]
-            lane_e = jnp.broadcast_to(
-                jnp.arange(B)[:, None], (B, T)).ravel()
-            perm = jnp.argsort(node_e, stable=True)
-            ns = node_e[perm]
-            lane_s = lane_e[perm]
-            req_s = jnp.where(ent_ok.ravel()[perm][:, None],
-                              req_b[lane_s], 0.0)                 # [K, R]
-            first = jnp.concatenate(
-                [jnp.ones((1,), bool), ns[1:] != ns[:-1]])
-            sidx = jax.lax.associative_scan(
-                jnp.maximum,
-                jnp.where(first, jnp.arange(ns.shape[0]), -1))
-            cs = jnp.cumsum(req_s, axis=0)
-            cum_e = cs - (cs - req_s)[sidx]       # inclusive, per node
-            nsafe = jnp.minimum(ns, n.n - 1)
-            real = ns < n.n
-            cap_pipe = (free + n.releasing + extra)[nsafe] + EPS
-            viol = jnp.any(cum_e > cap_pipe, -1) & real
-            # bind-now claims must collectively fit the chunk-start
-            # *idle* pool (pipelined flags were derived against
-            # chunk-start free) — same entries, bind amounts only
-            bind_e = (ent_ok & ~pipe_b).ravel()[perm]
-            reqb_s = jnp.where(bind_e[:, None], req_b[lane_s], 0.0)
-            csb = jnp.cumsum(reqb_s, axis=0)
-            cumb_e = csb - (csb - reqb_s)[sidx]
-            cap_bind = jnp.maximum(free, 0.0)[nsafe] + EPS
-            viol = viol | (jnp.any(cumb_e > cap_bind, -1) & real)
-            first_bad = jnp.min(jnp.where(viol, lane_s, B))
+            first_bad, node_e, lane_e = sparse_accept_first_bad(
+                nodes_b, ent_ok, pipe_b, req_b, free,
+                free + n.releasing + extra, n.n)
             prefix_ok = jnp.arange(B) < first_bad                 # [B]
         else:
             d_free = jnp.where(ok, free - free2_b, 0.0)           # [B, N, R]

@@ -67,7 +67,8 @@ from .allocate import (AllocateConfig, AllocationResult, _ancestor_gate,
                        _attempt_gang, _chain_membership, anti_defer_lanes,
                        anti_domain_tables, anti_forbid_nodes,
                        anti_mark_placements, attract_allow_nodes,
-                       attract_defer_lanes, init_result)
+                       attract_defer_lanes, init_result,
+                       sparse_accept_first_bad)
 from .scoring import W_OWN_FREED
 
 EPS = 1e-6
@@ -101,15 +102,41 @@ class VictimConfig:
     #: preempt's own chunk width; None = inherit ``batch_size``.
     #: Preempt chunks pack lanes across queues (queue-segmented budget
     #: math), so a many-queue snapshot wants chunks at least as wide as
-    #: its preemptor spread (512 queues × 1 preemptor measured 214 ms
-    #: at 64 lanes vs 136 ms at 256) — the Session auto-tunes this from
-    #: the snapshot's leaf-queue count.
+    #: its preemptor spread, while junk lanes past the live preemptor
+    #: count only add freed-pool cost — the Session auto-tunes this
+    #: from the snapshot's pending-gang spread and padded node count
+    #: (see ``Session.from_state``; measured sweep in BASELINE.md).
     batch_size_preempt: int | None = None
     #: reclaim may use the chunked path — False when the snapshot
     #: carries per-(victim,reclaimer) reclaim-minruntime protection,
     #: whose lane-dependent tables need the sequential path.  The
     #: Session derives this from the snapshot.
     chunk_reclaim: bool = False
+    #: cap on victims re-placed per consolidation scenario — ONE knob
+    #: for both the ``_replace_victims`` default and the consolidation
+    #: call site's ``max(max_victim_pods, max_consolidation_preemptees
+    #: * T)`` sizing (was a hard-coded 512 in two places)
+    max_victim_pods: int = 512
+    #: preempt sparse-lane wavefront: solve each lane against its OWN
+    #: queue's freed capacity only (queue-disjoint optimistic solve) and
+    #: verify composition with sparse (node-id, delta) segments instead
+    #: of dense [B, N, *] lane-prefix cumsums.  None = auto (enabled
+    #: whenever the snapshot shape supports the sparse placement
+    #: protocol — uniform tasks, no device table, no extended
+    #: resources, no subgroup topology); False forces the dense
+    #: composed path.  True still requires the structural conditions.
+    optimistic_preempt: bool | None = None
+    #: width of the compact per-queue eviction-unit tables the sparse
+    #: preempt path probes (top-K units per queue, the sparse analogue
+    #: of the dense [U, Q, R] cumulative tables).  An action whose
+    #: frozen unit order gives any queue more candidate units than this
+    #: falls back to the dense composed path at run time (counted by
+    #: the ``kai_victim_wavefront_sparse_fallbacks`` gauge).  None =
+    #: auto: the Session derives it from running-pod density per leaf
+    #: queue (non-Session callers get 256); an explicit value is
+    #: honored as-is, e.g. to bound table memory or force the dense
+    #: fallback for debugging.
+    sparse_unit_k: int | None = None
 
 
 def freed_by_mask(state: ClusterState, mask: jax.Array, chain: jax.Array):
@@ -518,7 +545,8 @@ def solve_for_preemptor(
                 state, mask_k, free2, dev2, n.releasing + extra_eff,
                 state.nodes.device_releasing + extra_dev_eff,
                 ext2, state.nodes.extended_releasing + ext_extra_eff,
-                max_pods=max(512, config.max_consolidation_preemptees * T))
+                max_pods=max(config.max_victim_pods,
+                             config.max_consolidation_preemptees * T))
             return success & all_ok, (
                 free3, dev3, qa2, qan2, nodes_t, dev_t, pipe_t, moves,
                 extra_eff, extra_dev_eff, ext3, ext_extra_eff, k)
@@ -616,7 +644,7 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
                      device_free: jax.Array, releasing: jax.Array,
                      device_releasing: jax.Array,
                      ext_free: jax.Array, ext_releasing: jax.Array,
-                     max_pods: int = 512):
+                     max_pods: int):
     """Greedy re-placement of evicted consolidation victims — the
     ``allPodsReallocated`` validator (``consolidation.go:115-120``): the
     scenario is valid only if *every* victim fits somewhere on the
@@ -630,7 +658,8 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
     an M-length device loop at 50k running pods faults the TPU.  A
     scenario with more than ``max_pods`` victims is rejected
     (``all_ok=False``), mirroring MaxNumberConsolidationPreemptees-style
-    caps.
+    caps; the cap comes from ``VictimConfig.max_victim_pods`` (one knob
+    for every call site).
 
     Returns (free' [N, R], device_free' [N, D], extended_free' [N, E],
     moves [M] i32 node per victim, all_ok [])."""
@@ -706,17 +735,30 @@ def _replace_victims(state: ClusterState, mask: jax.Array, free: jax.Array,
 
 
 def _freed_by_lane(state: ClusterState, lane: jax.Array, B: int,
-                   chain: jax.Array):
-    """Per-lane cumulative freed tensors from a pod→lane assignment.
+                   chain: jax.Array, *, compose: bool = True,
+                   track_devices: bool = True, extended: bool = True):
+    """Per-lane freed tensors from a pod→lane assignment.
 
     ``lane`` [M] gives each pod the FIRST wavefront lane that consumes
-    it (``B`` = not consumed this chunk); lane ``b``'s pool is the union
-    of lanes ``<= b``, so every per-lane prefix is a cumsum of per-lane
-    sums — ONE segment_sum over the pod axis instead of a vmapped
-    scatter per lane (vmapped scatters dominate the chunk cost on TPU).
-    Returns (freed_nodes [B,N,R], freed_dev [B,N,D], freed_queues
-    [B,Q,R], freed_ext [B,N,E], own_incr [B,N] — nodes where lane b's
-    OWN assignment freed capacity, the W_OWN_FREED score-bias input).
+    it (``B`` = not consumed this chunk).  With ``compose=True`` lane
+    ``b``'s pool is the union of lanes ``<= b``: every per-lane prefix
+    is a cumsum of per-lane sums — ONE segment_sum over the pod axis
+    instead of a vmapped scatter per lane (vmapped scatters dominate
+    the chunk cost on TPU).  With ``compose=False`` (the sparse
+    preempt wavefront) each lane's pool is its OWN assignment only and
+    the lane-prefix cumsum over the dense [B, N, *] tensors is skipped
+    entirely — composition is re-verified later on sparse (node, delta)
+    segments at the chunk's claim sites.
+
+    The device and extended tables are built only when the placement
+    config tracks them: a snapshot without fractional or MIG pods frees
+    nothing there, and the dense [B, N, D] table is the single biggest
+    HBM tensor of a chunk.
+
+    Returns (freed_nodes [B,N,R], freed_dev [B,N,D] | None,
+    freed_queues [B,Q,R], freed_ext [B,N,E] | None, own_incr [B,N] —
+    nodes where lane b's OWN assignment freed capacity, the
+    W_OWN_FREED score-bias input).
     """
     r, n, q = state.running, state.nodes, state.queues
     N, D, Q = n.n, n.d, q.q
@@ -727,37 +769,62 @@ def _freed_by_lane(state: ClusterState, lane: jax.Array, B: int,
     seg_n = lane_s * (N + 1) + node_s
     per_n = jax.ops.segment_sum(
         req_m, seg_n, num_segments=(B + 1) * (N + 1))
-    freed_n = jnp.cumsum(
-        per_n.reshape(B + 1, N + 1, -1)[:B, :N], axis=0)       # [B, N, R]
-    frac = live & (r.device >= 0)
-    seg_d = (jnp.where(frac, lane_s, B) * (N * D + 1)
-             + jnp.where(frac, node_s * D + jnp.maximum(r.device, 0),
-                         N * D))
-    per_d = jax.ops.segment_sum(
-        jnp.where(frac, r.accel_held, 0.0), seg_d,
-        num_segments=(B + 1) * (N * D + 1))
-    per_d = per_d.reshape(B + 1, N * D + 1)[:B, :N * D].reshape(B, N, D)
-    bits = ((r.devices_mask[:, None] >> jnp.arange(D)[None, :]) & 1)
-    whole = bits.astype(req_m.dtype) * (live & (r.device < 0))[:, None]
-    per_w = jax.ops.segment_sum(
-        whole, seg_n, num_segments=(B + 1) * (N + 1))
-    freed_d = jnp.cumsum(
-        per_d + per_w.reshape(B + 1, N + 1, D)[:B, :N], axis=0)
+    own_n = per_n.reshape(B + 1, N + 1, -1)[:B, :N]            # [B, N, R]
+    freed_n = jnp.cumsum(own_n, axis=0) if compose else own_n
+    freed_d = None
+    if track_devices:
+        frac = live & (r.device >= 0)
+        seg_d = (jnp.where(frac, lane_s, B) * (N * D + 1)
+                 + jnp.where(frac, node_s * D + jnp.maximum(r.device, 0),
+                             N * D))
+        per_d = jax.ops.segment_sum(
+            jnp.where(frac, r.accel_held, 0.0), seg_d,
+            num_segments=(B + 1) * (N * D + 1))
+        per_d = per_d.reshape(B + 1, N * D + 1)[:B, :N * D].reshape(
+            B, N, D)
+        bits = ((r.devices_mask[:, None] >> jnp.arange(D)[None, :]) & 1)
+        whole = bits.astype(req_m.dtype) * (live & (r.device < 0))[:, None]
+        per_w = jax.ops.segment_sum(
+            whole, seg_n, num_segments=(B + 1) * (N + 1))
+        own_d = per_d + per_w.reshape(B + 1, N + 1, D)[:B, :N]
+        freed_d = jnp.cumsum(own_d, axis=0) if compose else own_d
     seg_q = lane_s * (Q + 1) + jnp.where(live, jnp.maximum(r.queue, 0), Q)
     per_q = jax.ops.segment_sum(
         req_m, seg_q, num_segments=(B + 1) * (Q + 1))
-    leaf_cum = jnp.cumsum(
-        per_q.reshape(B + 1, Q + 1, -1)[:B, :Q], axis=0)       # [B, Q, R]
+    leaf_own = per_q.reshape(B + 1, Q + 1, -1)[:B, :Q]         # [B, Q, R]
+    leaf_cum = jnp.cumsum(leaf_own, axis=0) if compose else leaf_own
     freed_q = jnp.einsum("qa,bqr->bar", chain.astype(req_m.dtype),
                          leaf_cum)
-    per_e = jax.ops.segment_sum(
-        jnp.where(live[:, None], r.extended, 0.0), seg_n,
-        num_segments=(B + 1) * (N + 1))
-    freed_e = jnp.cumsum(
-        per_e.reshape(B + 1, N + 1, -1)[:B, :N], axis=0)
-    own_incr = jnp.sum(
-        per_n.reshape(B + 1, N + 1, -1)[:B, :N], axis=-1) > EPS  # [B, N]
+    freed_e = None
+    if extended:
+        per_e = jax.ops.segment_sum(
+            jnp.where(live[:, None], r.extended, 0.0), seg_n,
+            num_segments=(B + 1) * (N + 1))
+        own_e = per_e.reshape(B + 1, N + 1, -1)[:B, :N]
+        freed_e = jnp.cumsum(own_e, axis=0) if compose else own_e
+    own_incr = jnp.sum(own_n, axis=-1) > EPS                   # [B, N]
     return freed_n, freed_d, freed_q, freed_e, own_incr
+
+
+def _sparse_preempt_ok(config: VictimConfig) -> bool:
+    """Static gate of the sparse/optimistic preempt wavefront — the
+    same structural conditions as the allocate chunk's sparse protocol
+    (lanes emit placements only; a placement's claim is exactly its
+    gang's uniform replica request), which is also exactly when the
+    per-lane pools can skip the dense composition: uniform tasks, no
+    device table, no extended resources, no subgroup topology.
+    ``VictimConfig.optimistic_preempt=False`` forces the dense path;
+    ``True``/``None`` still require the structural conditions."""
+    p = config.placement
+    ok = (p.uniform_tasks and not p.track_devices and not p.extended
+          and not p.subgroup_topology)
+    if config.optimistic_preempt is not None:
+        ok = ok and config.optimistic_preempt
+    return ok
+
+
+#: ``AllocationResult.wavefront_stats`` row per chunked action
+_STATS_ROW = {"reclaim": 0, "preempt": 1}
 
 
 def _run_victim_action_chunked(
@@ -789,9 +856,9 @@ def _run_victim_action_chunked(
       queue's units and re-ranking yields the identical suffix), so the
       per-chunk consumed state is just a per-queue pointer ``c [Q]``
       over the frozen global rank space.
-    - all per-unit tables (requests, per-leaf-queue cumulative freed
-      ``C_leaf``, the strategy-bound subtree cumulative ``S_cols``,
-      leaf positions/counts) are built once; chunks probe them with
+    - all per-unit tables (requests, per-leaf-queue cumulative freed,
+      the strategy-bound subtree cumulative ``S_cols``, leaf
+      positions/counts) are built once; chunks probe them with
       searchsorted/gathers only.
     - the preemptor order is frozen once (``job_order_perm`` at action
       start) — the fairness interleaving across queues is baked into
@@ -817,6 +884,52 @@ def _run_victim_action_chunked(
     queue-cap and fair-share gates.  Per-pair reclaim-minruntime
     snapshots use the sequential path (``VictimConfig.chunk_reclaim``).
 
+    SPARSE LANE WAVEFRONT (preempt, ``_sparse_preempt_ok``): preempt
+    victims are same-queue only, so lanes from distinct queues share
+    nothing but node free capacity, and the problem is queue-disjoint
+    by construction.  The sparse path exploits that structure:
+
+    - the dense [U, Q, R] cumulative-freed tables (and their [B, U, R]
+      per-chunk gathers) shrink to compact per-queue top-K unit tables
+      ``Cq [Q, KU, R]`` / ``pos_c [Q, KU+1]`` / ``prio_c [Q, KU]``
+      probed with tiny searchsorteds;
+    - every lane solves OPTIMISTICALLY against its OWN queue's freed
+      capacity only (``_freed_by_lane(compose=False)``) — no [B, N, *]
+      lane-prefix cumsum is ever materialized;
+    - lanes emit placements only (the allocate chunk's sparse
+      protocol, ``sparse_out=True``) and composed node capacity is
+      re-verified on sparse (node, delta) segments: claim entries sort
+      by node, each entry checks its node-cumulative demand against
+      chunk-start capacity PLUS the lane-prefix of the sparse freed
+      deltas gathered at the claim sites (``sparse_entry_tables``) —
+      node-capacity over-subscription between lanes surfaces as a
+      first-bad-lane, the non-conflicting prefix commits in frozen
+      fairness order, and the conflicted tail retries next chunk where
+      the leading lane's inputs compose exactly;
+    - only the LEADING valid lane's gate/placement failure is final
+      (a later lane may have failed merely because the optimistic solve
+      hid earlier lanes' freed capacity from it);
+    - the deficit direction of that hiding is caught by the sparse
+      accept (over-subscription), and the SURPLUS direction by LEFTOVER
+      DEMOTION (both preempt paths): a committing lane whose victims
+      free more than its claims consume exposes net capacity the
+      sequential scan would offer every later preemptor, so every lane
+      after the first such lane conflict-retries and re-runs as the
+      leading lane of the next chunk, where inputs compose exactly.
+      The leading lane also solves WITHOUT the ``W_OWN_FREED`` score
+      band (a de-collision heuristic with no sequential counterpart
+      that outranks the density band), making its solve
+      reference-exact.  Demotions are counted in ``wavefront_stats``
+      (``kai_victim_wavefront_leftover_demotions``).
+
+    An action whose frozen unit order gives any queue more candidate
+    units than ``VictimConfig.sparse_unit_k`` falls back to the dense
+    composed path at run time (one ``lax.cond``, counted in
+    ``wavefront_stats`` — the incremental engine's auto-fallback
+    pattern); snapshots whose shape rejects the sparse placement
+    protocol (devices / extended / subgroup topology / non-uniform
+    gangs) take the dense path statically.
+
     Remaining deviations from the reference's one-preemptor-at-a-time
     walk, all chunk-granular: the preemptor and victim-job orders are
     frozen per action, and a lane's budget ignores units of its own
@@ -833,6 +946,8 @@ def _run_victim_action_chunked(
     B = max(1, min(bs, G))
     total = state.total_capacity
     pcfg = config.placement
+    track_dev = pcfg.track_devices
+    track_ext = pcfg.extended
     depth = (config.queue_depth_preempt
              if mode == "preempt" and config.queue_depth_preempt is not None
              else config.queue_depth)
@@ -841,6 +956,7 @@ def _run_victim_action_chunked(
     limit_eff_q = jnp.where(q.limit <= UNLIMITED + 0.5, jnp.inf, q.limit)
     gq = jnp.maximum(g.queue, 0)
     chain_f = chain.astype(jnp.float32)
+    ROW = _STATS_ROW[mode]
     # minruntime protection: preempt's resolved value is victim-side only
     # (lane-independent); chunked reclaim is gated on no reclaim
     # minruntime, so zeros there
@@ -854,7 +970,7 @@ def _run_victim_action_chunked(
     if anti:
         dom_static, _TA = anti_domain_tables(state)
 
-    # ---- hoisted: frozen eviction-unit order + per-unit tables ----------
+    # ---- hoisted: frozen eviction-unit order + per-unit inputs ----------
     cand0 = base0 & ~result.victim                               # [M]
     removed0 = result.victim & (result.victim_move < 0)
     unit_rank, num_units = _rank_eviction_units(
@@ -864,426 +980,715 @@ def _run_victim_action_chunked(
     unit_req = jax.ops.segment_sum(
         jnp.where(cand0[:, None], r.req, 0.0), urank_safe,
         num_segments=M + 1)[:M]                                  # [U, R]
-    C_all = cumsum_ds(unit_req, axis=0)                          # inclusive
     unit_leaf = jax.ops.segment_max(
         jnp.where(cand0, r.queue, -1), urank_safe,
         num_segments=M + 1)[:M]                                  # [U]
     leaf_safe = jnp.maximum(unit_leaf, 0)
     has_leaf = unit_leaf >= 0
-    onehot_leaf = ((unit_leaf[:, None] == jnp.arange(Q)[None, :])
-                   & has_leaf[:, None])                          # [U, Q]
-    C_leaf = cumsum_ds(
-        onehot_leaf[:, :, None] * unit_req[:, None, :], axis=0)  # [U, Q, R]
-    cnt_leaf = jnp.cumsum(onehot_leaf.astype(jnp.int32), axis=0)
-    cl = jnp.concatenate(
-        [jnp.zeros((1, Q), jnp.int32), cnt_leaf])                # [U+1, Q]
-    r_in_q = cl[jnp.arange(M), leaf_safe]                        # [U]
-    pos_q = jnp.full((Q + 1, M), M, jnp.int32).at[
-        jnp.where(has_leaf, leaf_safe, Q), r_in_q].set(
-            jnp.arange(M, dtype=jnp.int32))[:Q]                  # [Q, U]
     if reclaim:
-        # EXCLUSIVE-before-u subtree-cumulative freed (strategy bounds)
-        inc_sub = ((chain[leaf_safe] & has_leaf[:, None])[:, :, None]
-                   * unit_req[:, None, :])                       # [U, Q, R]
-        S_cols = (cumsum_ds(inc_sub, axis=0) - inc_sub).reshape(M, Q * R_)
-        prio_by_q = None
+        C_all = cumsum_ds(unit_req, axis=0)                      # inclusive
+        unit_prio = None
     else:
+        C_all = None
         unit_prio = jax.ops.segment_max(
             jnp.where(cand0, gang_prio_pod, -BIG), urank_safe,
             num_segments=M + 1)[:M].astype(jnp.float32)          # [U]
-        prio_by_q = jnp.full((Q + 1, M), jnp.float32(1e30)).at[
-            jnp.where(has_leaf, leaf_safe, Q), r_in_q].set(
-                unit_prio)[:Q]                                   # [Q, U]
-        S_cols = None
 
     # ---- hoisted: frozen preemptor order ---------------------------------
     order0 = ordering.job_order_perm(
         g, q, result.queue_allocated, fair_share, total, remaining0)
-    qi_ord = gq[order0]                                          # [G]
 
     lanes = jnp.arange(B, dtype=jnp.int32)
     qidx = jnp.arange(Q)
     pod_leaf = jnp.clip(r.queue, 0, Q - 1)                       # [M]
 
-    def chunk(carry):
-        res, remaining, c, q_att, fuel = carry
-        free, dev = res.free, res.device_free
-        qa = res.queue_allocated
-        qan = res.queue_allocated_nonpreemptible
-        extra, extra_dev = res.releasing_extra, res.device_releasing_extra
-        ext = res.extended_free
-        ext_extra = res.extended_releasing_extra
+    sparse_able = (not reclaim) and _sparse_preempt_ok(config)
+    # an explicit sparse_unit_k is honored as-is (the documented way to
+    # bound table memory or force the dense fallback for debugging);
+    # only the non-Session default is floored
+    KU = (max(1, int(config.sparse_unit_k))
+          if config.sparse_unit_k is not None else 256)
 
-        # ---- lanes: first B remaining gangs in frozen order -------------
-        # (any queue mix: preempt's own-queue-local budgets/consumption
-        # are kept exact by QUEUE-SEGMENTED cumulative pricing, unit
-        # ranks, watermarks and pointers below — a 256-preemptor burst
-        # in one queue packs B lanes per chunk like the single-queue
-        # code always did, AND 512 queues × 1 preemptor each share
-        # chunks instead of degrading to one queue per chunk)
-        flags = remaining[order0]                                # [G]
-        rnk = jnp.cumsum(flags.astype(jnp.int32)) - 1
-        pos = jnp.where(flags & (rnk < B), rnk, B)
-        cand_g = jnp.full((B + 1,), G, jnp.int32).at[pos].set(order0)[:B]
-        cand_valid = jnp.zeros((B + 1,), bool).at[pos].set(True)[:B]
-        gsafe_b = jnp.minimum(cand_g, G - 1)
-        q_b = gq[gsafe_b]                                        # [B]
-        # lanes of the same queue (preempt's segmented per-queue math)
-        same_q_b = (q_b[None, :] == q_b[:, None])                # [B, B]
+    def make_run(sparse: bool, fell_back: bool):
+        """Build one flavor of the chunk loop.  The per-mode hoisted
+        tables live INSIDE the closure so the un-taken ``lax.cond``
+        branch never materializes the other flavor's tensors."""
 
-        # ---- lane budgets over the frozen unit order --------------------
-        lane_req = jnp.where(cand_valid[:, None],
-                             task_req_g[gsafe_b], 0.0)           # [B, R]
-        cum_req = jnp.cumsum(lane_req, axis=0)
-        cluster_free = jnp.sum(
-            jnp.where(n.valid[:, None], free + n.releasing + extra, 0.0),
-            axis=0)
-        if reclaim:
-            targets = cum_req - cluster_free[None, :] - EPS      # [B, R]
+        if sparse:
+            # compact per-queue unit tables — the sparse analogue of the
+            # dense [U, Q, *] cumulatives.  Each unit's ordinal within
+            # its queue comes from one stable [M] argsort (rank order is
+            # preserved within a queue), then tiny [Q, KU] scatters.
+            leaf_key = jnp.where(has_leaf, leaf_safe, Q)
+            perm_u = jnp.argsort(leaf_key.astype(jnp.int32), stable=True)
+            lk_p = leaf_key[perm_u]
+            first_u = jnp.concatenate(
+                [jnp.ones((1,), bool), lk_p[1:] != lk_p[:-1]])
+            seg_start = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(first_u, jnp.arange(M), -1))
+            r_p = (jnp.arange(M) - seg_start).astype(jnp.int32)
+            r_in_q = jnp.zeros((M,), jnp.int32).at[perm_u].set(r_p)
+            # pos_c[q, j] = global unit rank of queue q's j-th unit; the
+            # KU column (and every missing slot) is the junk rank M —
+            # ordinal overflow clamps there, which the action-level
+            # overflow cond has already excluded
+            rk = jnp.minimum(r_in_q, KU)
+            pos_c = jnp.full((Q + 1, KU + 1), M, jnp.int32).at[
+                jnp.where(has_leaf, leaf_safe, Q),
+                jnp.where(has_leaf, rk, KU)].set(
+                jnp.where(has_leaf & (r_in_q < KU),
+                          jnp.arange(M, dtype=jnp.int32), M))[:Q]
+            pos_k = pos_c[:, :KU]                                # [Q, KU]
+            valid_pos = pos_k < M
+            pos_safe = jnp.minimum(pos_k, M - 1)
+            # per-queue inclusive cumulative unit requests / priorities
+            Cq = cumsum_ds(jnp.where(valid_pos[..., None],
+                                     unit_req[pos_safe], 0.0),
+                           axis=1)                               # [Q, KU, R]
+            prio_c = jnp.where(valid_pos, unit_prio[pos_safe],
+                               jnp.float32(1e30))                # [Q, KU]
         else:
-            # QUEUE-SEGMENTED cumulative pricing: a lane's target is the
-            # cumulative request of its OWN queue's lanes so far (its
-            # victims can only come from there), optimistically assuming
-            # the whole idle pool (queues double-counting free
-            # under-evict, which the accept prefix rejects and the lane
-            # retries next chunk — over-eviction never happens).  For a
-            # single-queue chunk this is exactly the full cumulative.
-            seg_incl = (same_q_b & (lanes[None, :] <= lanes[:, None])
-                        & cand_valid[None, :])                   # [B, B]
-            cum_req_q = jnp.einsum(
-                "bc,cr->br", seg_incl.astype(lane_req.dtype), lane_req)
-            targets = cum_req_q - cluster_free[None, :] - EPS
-        need_b = cand_valid & jnp.any(targets > 0, axis=-1)
-        csafe = jnp.clip(c, 0, M - 1)
-        Cv_at_c = jnp.where((c >= 0)[:, None],
-                            C_leaf[csafe, qidx], 0.0)            # [Q, R]
-        if reclaim:
-            arr_b = C_all[None] - C_leaf[:, q_b].transpose(1, 0, 2)
-            base_b = (jnp.sum(Cv_at_c, axis=0)[None, :]
-                      - Cv_at_c[q_b])                            # [B, R]
-        else:
-            arr_b = C_leaf[:, q_b].transpose(1, 0, 2)            # [B, U, R]
-            base_b = Cv_at_c[q_b]
-        k_rb = jax.vmap(jax.vmap(jnp.searchsorted, in_axes=(1, 0)))(
-            arr_b, targets + base_b)                             # [B, R]
-        K_cap = jnp.where(need_b, jnp.max(k_rb, axis=1), -1
-                          ).astype(jnp.int32)                    # [B]
-        # a victim scenario always contains >= 1 NEW eviction unit (the
-        # sequential search's smallest scenario is unit-prefix 0 — the
-        # scenario builder never yields an empty victim set): lane b
-        # consumes at least the (b+1)-th unit still available TO IT
-        avail_u = (has_leaf & (jnp.arange(M) < num_units)
-                   & (jnp.arange(M) > c[jnp.clip(unit_leaf, 0, Q - 1)]))
-        cum_av_leaf = jnp.cumsum(
-            (avail_u[:, None] & onehot_leaf).astype(jnp.int32), axis=0)
-        cum_av = jnp.cumsum(avail_u.astype(jnp.int32))           # [U]
-        if reclaim:
-            cum_av_b = cum_av[None, :] - cum_av_leaf[:, q_b].T   # [B, U]
-            vrank = jnp.cumsum(cand_valid.astype(jnp.int32)) - 1  # [B]
-        else:
-            cum_av_b = cum_av_leaf[:, q_b].T
-            # ordinal among the lane's OWN queue's valid lanes: the
-            # (k+1)-th same-queue lane needs k+1 available own units
-            vrank = jnp.sum(
-                same_q_b & (lanes[None, :] < lanes[:, None])
-                & cand_valid[None, :], axis=1).astype(jnp.int32)
-        K_min = jax.vmap(jnp.searchsorted)(
-            cum_av_b, vrank + 1).astype(jnp.int32)               # [B]
-        K_raw = jnp.where(cand_valid, jnp.maximum(K_cap, K_min), -1)
-        K_b = jax.lax.associative_scan(jnp.maximum, K_raw)       # sorted
-        insufficient_b = cand_valid & (K_raw >= num_units)
+            onehot_leaf = ((unit_leaf[:, None] == jnp.arange(Q)[None, :])
+                           & has_leaf[:, None])                  # [U, Q]
+            C_leaf = cumsum_ds(
+                onehot_leaf[:, :, None] * unit_req[:, None, :],
+                axis=0)                                          # [U, Q, R]
+            cnt_leaf = jnp.cumsum(onehot_leaf.astype(jnp.int32), axis=0)
+            cl = jnp.concatenate(
+                [jnp.zeros((1, Q), jnp.int32), cnt_leaf])        # [U+1, Q]
+            r_in_q = cl[jnp.arange(M), leaf_safe]                # [U]
+            pos_q = jnp.full((Q + 1, M), M, jnp.int32).at[
+                jnp.where(has_leaf, leaf_safe, Q), r_in_q].set(
+                    jnp.arange(M, dtype=jnp.int32))[:Q]          # [Q, U]
+            if reclaim:
+                # EXCLUSIVE-before-u subtree-cumulative freed (strategy
+                # bounds)
+                inc_sub = ((chain[leaf_safe] & has_leaf[:, None])[:, :, None]
+                           * unit_req[:, None, :])               # [U, Q, R]
+                S_cols = (cumsum_ds(inc_sub, axis=0)
+                          - inc_sub).reshape(M, Q * R_)
+            else:
+                prio_by_q = jnp.full((Q + 1, M), jnp.float32(1e30)).at[
+                    jnp.where(has_leaf, leaf_safe, Q), r_in_q].set(
+                        unit_prio)[:Q]                           # [Q, U]
 
-        # ---- strategy / priority admissibility bound --------------------
-        if reclaim:
-            # FitsReclaimStrategy, probed on the hoisted subtree
-            # cumulative: unit u passes while its leveled queue's
-            # remaining share BEFORE u (live qa corrected by the
-            # already-consumed rollup S_cons) stays above fair share —
-            # or above deserved quota when the reclaimer is under its
-            # own quota.
-            S_cons = jnp.einsum("va,vr->ar", chain_f, Cv_at_c)   # [Q, R]
-            thr_fs = (qa - fair_share - EPS + S_cons).reshape(-1)
-            bnd_fs = jnp.max(jax.vmap(jnp.searchsorted, in_axes=(1, 0))(
-                S_cols, thr_fs).reshape(Q, R_), axis=1)          # [Q]
-            thr_qt = (jnp.where(jnp.isinf(quota_eff_q), -jnp.inf,
-                                qa - quota_eff_q - EPS)
-                      + S_cons).reshape(-1)
-            bnd_qt = jnp.max(jax.vmap(jnp.searchsorted, in_axes=(1, 0))(
-                S_cols, thr_qt).reshape(Q, R_), axis=1)          # [Q]
-            under_b = jax.vmap(
+        def chunk(carry):
+            res, remaining, c, q_att, fuel = carry
+            free, dev = res.free, res.device_free
+            qa = res.queue_allocated
+            qan = res.queue_allocated_nonpreemptible
+            extra = res.releasing_extra
+            extra_dev = res.device_releasing_extra
+            ext = res.extended_free
+            ext_extra = res.extended_releasing_extra
+
+            # ---- lanes: first B remaining gangs in frozen order ---------
+            # (any queue mix: preempt's own-queue-local budgets/
+            # consumption are kept exact by QUEUE-SEGMENTED cumulative
+            # pricing, unit ranks, watermarks and pointers below — a
+            # 256-preemptor burst in one queue packs B lanes per chunk
+            # like the single-queue code always did, AND 512 queues × 1
+            # preemptor each share chunks instead of degrading to one
+            # queue per chunk)
+            flags = remaining[order0]                            # [G]
+            rnk = jnp.cumsum(flags.astype(jnp.int32)) - 1
+            pos = jnp.where(flags & (rnk < B), rnk, B)
+            cand_g = jnp.full((B + 1,), G, jnp.int32).at[pos].set(
+                order0)[:B]
+            cand_valid = jnp.zeros((B + 1,), bool).at[pos].set(True)[:B]
+            gsafe_b = jnp.minimum(cand_g, G - 1)
+            q_b = gq[gsafe_b]                                    # [B]
+            # lanes of the same queue (preempt's segmented per-queue math)
+            same_q_b = (q_b[None, :] == q_b[:, None])            # [B, B]
+
+            # ---- lane budgets over the frozen unit order ----------------
+            lane_req = jnp.where(cand_valid[:, None],
+                                 task_req_g[gsafe_b], 0.0)       # [B, R]
+            cluster_free = jnp.sum(
+                jnp.where(n.valid[:, None],
+                          free + n.releasing + extra, 0.0),
+                axis=0)
+            if reclaim:
+                cum_req = jnp.cumsum(lane_req, axis=0)
+                targets = cum_req - cluster_free[None, :] - EPS  # [B, R]
+            else:
+                # QUEUE-SEGMENTED cumulative pricing: a lane's target is
+                # the cumulative request of its OWN queue's lanes so far
+                # (its victims can only come from there), optimistically
+                # assuming the whole idle pool (queues double-counting
+                # free under-evict, which the accept prefix rejects and
+                # the lane retries next chunk — over-eviction never
+                # happens).  For a single-queue chunk this is exactly
+                # the full cumulative.
+                seg_incl = (same_q_b & (lanes[None, :] <= lanes[:, None])
+                            & cand_valid[None, :])               # [B, B]
+                cum_req_q = jnp.einsum(
+                    "bc,cr->br", seg_incl.astype(lane_req.dtype), lane_req)
+                targets = cum_req_q - cluster_free[None, :] - EPS
+            need_b = cand_valid & jnp.any(targets > 0, axis=-1)
+            if sparse:
+                # probe the compact per-queue tables: own-queue consumed
+                # base at the pointer, then a [KU]-searchsorted per
+                # (lane, resource) instead of the dense [B, U, R] gather
+                j_c = jax.vmap(
+                    lambda row, cv: jnp.searchsorted(
+                        row, cv, side="right"))(pos_k, c)        # [Q]
+                Cv_c = jnp.where(
+                    (j_c > 0)[:, None],
+                    Cq[qidx, jnp.maximum(j_c - 1, 0)], 0.0)      # [Q, R]
+                base_b = Cv_c[q_b]                               # [B, R]
+                v_b = targets + base_b
+                pos_full_b = pos_c[q_b]                          # [B, KU+1]
+                j_rb = jax.vmap(jax.vmap(jnp.searchsorted,
+                                         in_axes=(1, 0)))(
+                    Cq[q_b], v_b)                                # [B, R]
+                # a non-positive target is already covered by rank 0
+                # (the dense searchsorted's answer on the step function)
+                k_rb = jnp.where(
+                    v_b > 0,
+                    jnp.take_along_axis(pos_full_b,
+                                        jnp.minimum(j_rb, KU), axis=1),
+                    0)
+            else:
+                csafe = jnp.clip(c, 0, M - 1)
+                Cv_at_c = jnp.where((c >= 0)[:, None],
+                                    C_leaf[csafe, qidx], 0.0)    # [Q, R]
+                if reclaim:
+                    arr_b = C_all[None] - C_leaf[:, q_b].transpose(1, 0, 2)
+                    base_b = (jnp.sum(Cv_at_c, axis=0)[None, :]
+                              - Cv_at_c[q_b])                    # [B, R]
+                else:
+                    arr_b = C_leaf[:, q_b].transpose(1, 0, 2)    # [B, U, R]
+                    base_b = Cv_at_c[q_b]
+                k_rb = jax.vmap(jax.vmap(jnp.searchsorted,
+                                         in_axes=(1, 0)))(
+                    arr_b, targets + base_b)                     # [B, R]
+            K_cap = jnp.where(need_b, jnp.max(k_rb, axis=1), -1
+                              ).astype(jnp.int32)                # [B]
+            # a victim scenario always contains >= 1 NEW eviction unit
+            # (the sequential search's smallest scenario is unit-prefix
+            # 0 — the scenario builder never yields an empty victim
+            # set): lane b consumes at least the (b+1)-th unit still
+            # available TO IT
+            if reclaim:
+                vrank = jnp.cumsum(cand_valid.astype(jnp.int32)) - 1  # [B]
+            else:
+                # ordinal among the lane's OWN queue's valid lanes: the
+                # (k+1)-th same-queue lane needs k+1 available own units
+                vrank = jnp.sum(
+                    same_q_b & (lanes[None, :] < lanes[:, None])
+                    & cand_valid[None, :], axis=1).astype(jnp.int32)
+            if sparse:
+                av_c = (valid_pos & (pos_k < num_units)
+                        & (pos_k > c[:, None]))                  # [Q, KU]
+                cav = jnp.cumsum(av_c.astype(jnp.int32), axis=1)
+                j_min = jax.vmap(jnp.searchsorted)(cav[q_b], vrank + 1)
+                K_min = jnp.take_along_axis(
+                    pos_full_b, jnp.minimum(j_min, KU)[:, None],
+                    axis=1)[:, 0].astype(jnp.int32)              # [B]
+            else:
+                avail_u = (has_leaf & (jnp.arange(M) < num_units)
+                           & (jnp.arange(M)
+                              > c[jnp.clip(unit_leaf, 0, Q - 1)]))
+                cum_av_leaf = jnp.cumsum(
+                    (avail_u[:, None] & onehot_leaf).astype(jnp.int32),
+                    axis=0)
+                cum_av = jnp.cumsum(avail_u.astype(jnp.int32))   # [U]
+                if reclaim:
+                    cum_av_b = cum_av[None, :] - cum_av_leaf[:, q_b].T
+                else:
+                    cum_av_b = cum_av_leaf[:, q_b].T             # [B, U]
+                K_min = jax.vmap(jnp.searchsorted)(
+                    cum_av_b, vrank + 1).astype(jnp.int32)       # [B]
+            K_raw = jnp.where(cand_valid, jnp.maximum(K_cap, K_min), -1)
+            K_b = jax.lax.associative_scan(jnp.maximum, K_raw)   # sorted
+            insufficient_b = cand_valid & (K_raw >= num_units)
+
+            # ---- strategy / priority admissibility bound ----------------
+            if reclaim:
+                # FitsReclaimStrategy, probed on the hoisted subtree
+                # cumulative: unit u passes while its leveled queue's
+                # remaining share BEFORE u (live qa corrected by the
+                # already-consumed rollup S_cons) stays above fair share
+                # — or above deserved quota when the reclaimer is under
+                # its own quota.
+                S_cons = jnp.einsum("va,vr->ar", chain_f, Cv_at_c)  # [Q, R]
+                thr_fs = (qa - fair_share - EPS + S_cons).reshape(-1)
+                bnd_fs = jnp.max(jax.vmap(
+                    jnp.searchsorted, in_axes=(1, 0))(
+                    S_cols, thr_fs).reshape(Q, R_), axis=1)      # [Q]
+                thr_qt = (jnp.where(jnp.isinf(quota_eff_q), -jnp.inf,
+                                    qa - quota_eff_q - EPS)
+                          + S_cons).reshape(-1)
+                bnd_qt = jnp.max(jax.vmap(
+                    jnp.searchsorted, in_axes=(1, 0))(
+                    S_cols, thr_qt).reshape(Q, R_), axis=1)      # [Q]
+                under_b = jax.vmap(
+                    lambda qi, tr: _ancestor_gate(
+                        q.parent, qi, num_levels, qa, q.quota, tr))(
+                            q_b, lane_req)
+                bnd_eff = jnp.where(
+                    under_b[None, :],
+                    jnp.maximum(bnd_fs, bnd_qt)[:, None],
+                    bnd_fs[:, None])                             # [Q, B]
+                lq_vb = lq_tab[:, q_b]                           # [Q, B]
+                x_vb = jnp.clip(jnp.take_along_axis(
+                    bnd_eff, jnp.clip(lq_vb, 0, Q - 1), axis=0), 0, M)
+                cnt_before = cl[x_vb, qidx[:, None]]             # [Q, B]
+                first_bad_vb = pos_q[qidx[:, None],
+                                     jnp.clip(cnt_before, 0, M - 1)]
+                first_bad_vb = jnp.where(lq_vb >= 0, first_bad_vb, M)
+                hi_b = jnp.minimum(jnp.min(first_bad_vb, axis=0),
+                                   num_units) - 1                # [B]
+            elif sparse:
+                # victim units are priority-ascending within the queue;
+                # a lane may only consume own-queue units strictly below
+                # its priority — probed on the compact table
+                allowed = jax.vmap(jnp.searchsorted)(
+                    prio_c[q_b],
+                    g.priority[gsafe_b].astype(jnp.float32))     # [B]
+                hi_b = jnp.take_along_axis(
+                    pos_full_b, jnp.clip(allowed, 0, KU)[:, None],
+                    axis=1)[:, 0] - 1
+                hi_b = jnp.where(allowed > 0, hi_b, -1)
+            else:
+                # victim units are priority-ascending within the queue; a
+                # lane may only consume own-queue units strictly below its
+                # priority
+                allowed = jax.vmap(jnp.searchsorted)(
+                    prio_by_q[q_b],
+                    g.priority[gsafe_b].astype(jnp.float32))     # [B]
+                hi_b = pos_q[q_b, jnp.clip(allowed, 0, M - 1)] - 1
+                hi_b = jnp.where(allowed > 0, hi_b, -1)
+
+            # ---- lane gates ---------------------------------------------
+            nonpre_b = ~g.preemptible[gsafe_b]
+            gate_np_b = jax.vmap(
                 lambda qi, tr: _ancestor_gate(
-                    q.parent, qi, num_levels, qa, q.quota, tr))(
+                    q.parent, qi, num_levels, qan, q.quota, tr))(
                         q_b, lane_req)
-            bnd_eff = jnp.where(
-                under_b[None, :],
-                jnp.maximum(bnd_fs, bnd_qt)[:, None],
-                bnd_fs[:, None])                                 # [Q, B]
-            lq_vb = lq_tab[:, q_b]                               # [Q, B]
-            x_vb = jnp.clip(jnp.take_along_axis(
-                bnd_eff, jnp.clip(lq_vb, 0, Q - 1), axis=0), 0, M)
-            cnt_before = cl[x_vb, qidx[:, None]]                 # [Q, B]
-            first_bad_vb = pos_q[qidx[:, None],
-                                 jnp.clip(cnt_before, 0, M - 1)]
-            first_bad_vb = jnp.where(lq_vb >= 0, first_bad_vb, M)
-            hi_b = jnp.minimum(jnp.min(first_bad_vb, axis=0),
-                               num_units) - 1                    # [B]
-        else:
-            # victim units are priority-ascending within the queue; a
-            # lane may only consume own-queue units strictly below its
-            # priority
-            allowed = jax.vmap(jnp.searchsorted)(
-                prio_by_q[q_b],
-                g.priority[gsafe_b].astype(jnp.float32))         # [B]
-            hi_b = pos_q[q_b, jnp.clip(allowed, 0, M - 1)] - 1
-            hi_b = jnp.where(allowed > 0, hi_b, -1)
+            gate_b = jnp.where(nonpre_b, gate_np_b, True)
+            gate_b &= cand_valid & (K_raw <= hi_b) & ~insufficient_b
 
-        # ---- lane gates --------------------------------------------------
-        nonpre_b = ~g.preemptible[gsafe_b]
-        gate_np_b = jax.vmap(
-            lambda qi, tr: _ancestor_gate(
-                q.parent, qi, num_levels, qan, q.quota, tr))(
-                    q_b, lane_req)
-        gate_b = jnp.where(nonpre_b, gate_np_b, True)
-        gate_b &= cand_valid & (K_raw <= hi_b) & ~insufficient_b
+            # ---- pod → lane assignment + per-lane freed pools -----------
+            live0 = cand0 & (unit_rank > c[pod_leaf])
+            if reclaim:
+                # first lane whose budget covers the pod AND whose queue
+                # may evict it: a unit skipped by its own queue's lane
+                # flows to the next other-queue lane instead of being
+                # lost
+                may = q_b[None, :] != jnp.arange(Q)[:, None]     # [Q, B]
+                may = may & cand_valid[None, :]
+                nxt = jnp.where(may, lanes[None, :], B)          # [Q, B]
+                next_ok = jnp.flip(jax.lax.associative_scan(
+                    jnp.minimum, jnp.flip(nxt, axis=1), axis=1),
+                    axis=1)                                      # [Q, B]
+                next_ok = jnp.concatenate(
+                    [next_ok, jnp.full((Q, 1), B, jnp.int32)],
+                    axis=1)                                      # [Q, B+1]
+                lane0 = jnp.searchsorted(K_b, unit_rank)         # [M] 0..B
+                lane_of_pod = jnp.where(
+                    live0, next_ok[pod_leaf, jnp.minimum(lane0, B)], B)
+            else:
+                # PER-QUEUE running-max watermark: a unit flows to the
+                # first same-queue lane whose watermark covers its rank
+                # (exactly the old single-queue assignment, segmented
+                # per queue — no cross-queue leak).  [M, B] compare-and-
+                # min; B is small.
+                K_wm = jnp.max(jnp.where(
+                    same_q_b & (lanes[None, :] <= lanes[:, None])
+                    & cand_valid[None, :], K_raw[None, :], -1),
+                    axis=1)                                      # [B]
+                cand_lane = ((pod_leaf[:, None] == q_b[None, :])
+                             & cand_valid[None, :]
+                             & (K_wm[None, :] >= urank_safe[:, None]))
+                lane_of_pod = jnp.where(
+                    live0,
+                    jnp.min(jnp.where(cand_lane, lanes[None, :], B),
+                            axis=1), B)
+            (freed_n_b, freed_d_b, freed_q_b, freed_e_b,
+             own_incr_b) = _freed_by_lane(
+                state, lane_of_pod, B, chain, compose=not sparse,
+                track_devices=track_dev, extended=track_ext)
+            extra_b = extra[None] + freed_n_b                    # [B, N, R]
+            if track_dev:
+                extra_dev_b = extra_dev[None] + freed_d_b
+                dev_ax = 0
+            else:
+                extra_dev_b = extra_dev
+                dev_ax = None
+            if track_ext:
+                ext_extra_b = ext_extra[None] + freed_e_b
+                ext_ax = 0
+            else:
+                ext_extra_b = ext_extra
+                ext_ax = None
+            qa_eff_b = qa[None] - freed_q_b                      # [B, Q, R]
+            if reclaim:
+                # CanReclaimResources against the POST-SCENARIO state
+                # (the lane's own victim credit applied): a dept at its
+                # full fair share can still reclaim within itself
+                gate_b &= jax.vmap(
+                    lambda qi, tr, qae: _ancestor_gate(
+                        q.parent, qi, num_levels, qae, fair_share, tr))(
+                            q_b, lane_req, qa_eff_b)
+            lead = cand_valid & (jnp.cumsum(
+                cand_valid.astype(jnp.int32)) == 1)              # [B]
+            bias_b = W_OWN_FREED * own_incr_b.astype(jnp.float32)  # [B, N]
+            if not reclaim:
+                # the LEADING valid lane's inputs compose exactly, so
+                # its solve must be reference-exact: the own-freed band
+                # is a cross-lane de-collision heuristic with no
+                # sequential counterpart, and at 9.5 it outranks the
+                # density band (max 9) — keeping it on the leading lane
+                # flips placements the sequential scan scores purely by
+                # density (e.g. toward an earlier preemptor's leftover
+                # freed node)
+                bias_b = jnp.where(lead[:, None], 0.0, bias_b)
+            if anti:
+                dmask_b = ~anti_forbid_nodes(state, res.anti_used,
+                                             dom_static, cand_g)  # [B, N]
+                dup_b = anti_defer_lanes(state, cand_g, cand_valid)
+                if pcfg.attract_groups:
+                    dmask_b = dmask_b & attract_allow_nodes(
+                        state, res.anti_used, dom_static, cand_g)
+                    dup_b = dup_b | attract_defer_lanes(
+                        state, cand_g, cand_valid, res.anti_used)
+            else:
+                dmask_b = jnp.ones((B, n.n), bool)
+                dup_b = jnp.zeros((B,), bool)
+            if sparse:
+                # lanes emit placements only (the allocate chunk's
+                # sparse wavefront protocol) — no dense [B, N, R]
+                # carries through the vmap
+                (qa2_b, qan2_b, nodes_b, pipe_b, succ_b) = jax.vmap(
+                    lambda gi, lane, ex_n, ex_d, ex_e, qae, sb, dm:
+                        _attempt_gang(
+                            state, gi, free, dev, qae, qan, num_levels,
+                            pcfg, ex_n, ex_d, lane, chain, ext_free=ext,
+                            extra_extended_releasing=ex_e, score_bias=sb,
+                            domain_mask=dm, sparse_out=True),
+                    in_axes=(0, 0, 0, dev_ax, ext_ax, 0, 0, 0))(
+                    cand_g, lanes, extra_b, extra_dev_b, ext_extra_b,
+                    qa_eff_b, bias_b, dmask_b)
+                devt_b = jnp.full((B, T), -1, jnp.int32)
+            else:
+                (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b,
+                 succ_b, bind_b, devbind_b, ext2_b, extbind_b) = jax.vmap(
+                    lambda gi, lane, ex_n, ex_d, ex_e, qae, sb, dm:
+                        _attempt_gang(
+                            state, gi, free, dev, qae, qan, num_levels,
+                            pcfg, ex_n, ex_d, lane, chain, ext_free=ext,
+                            extra_extended_releasing=ex_e, score_bias=sb,
+                            domain_mask=dm),
+                    in_axes=(0, 0, 0, dev_ax, ext_ax, 0, 0, 0))(
+                    cand_g, lanes, extra_b, extra_dev_b, ext_extra_b,
+                    qa_eff_b, bias_b, dmask_b)
 
-        # ---- pod → lane assignment + per-lane freed pools ---------------
-        live0 = cand0 & (unit_rank > c[pod_leaf])
-        if reclaim:
-            # first lane whose budget covers the pod AND whose queue may
-            # evict it: a unit skipped by its own queue's lane flows to
-            # the next other-queue lane instead of being lost
-            may = q_b[None, :] != jnp.arange(Q)[:, None]         # [Q, B]
-            may = may & cand_valid[None, :]
-            nxt = jnp.where(may, lanes[None, :], B)              # [Q, B]
-            next_ok = jnp.flip(jax.lax.associative_scan(
-                jnp.minimum, jnp.flip(nxt, axis=1), axis=1),
-                axis=1)                                          # [Q, B]
-            next_ok = jnp.concatenate(
-                [next_ok, jnp.full((Q, 1), B, jnp.int32)],
-                axis=1)                                          # [Q, B+1]
-            lane0 = jnp.searchsorted(K_b, unit_rank)             # [M] 0..B
-            lane_of_pod = jnp.where(
-                live0, next_ok[pod_leaf, jnp.minimum(lane0, B)], B)
-        else:
-            # PER-QUEUE running-max watermark: a unit flows to the first
-            # same-queue lane whose watermark covers its rank (exactly
-            # the old single-queue assignment, segmented per queue — no
-            # cross-queue leak).  [M, B] compare-and-min; B is small.
-            K_wm = jnp.max(jnp.where(
-                same_q_b & (lanes[None, :] <= lanes[:, None])
-                & cand_valid[None, :], K_raw[None, :], -1),
-                axis=1)                                          # [B]
-            cand_lane = ((pod_leaf[:, None] == q_b[None, :])
-                         & cand_valid[None, :]
-                         & (K_wm[None, :] >= urank_safe[:, None]))
-            lane_of_pod = jnp.where(
-                live0,
-                jnp.min(jnp.where(cand_lane, lanes[None, :], B),
-                        axis=1), B)
-        (freed_n_b, freed_d_b, freed_q_b, freed_e_b,
-         own_incr_b) = _freed_by_lane(state, lane_of_pod, B, chain)
-        extra_b = extra[None] + freed_n_b                        # [B, N, R]
-        extra_dev_b = extra_dev[None] + freed_d_b
-        ext_extra_b = ext_extra[None] + freed_e_b
-        qa_eff_b = qa[None] - freed_q_b                          # [B, Q, R]
-        if reclaim:
-            # CanReclaimResources against the POST-SCENARIO state (the
-            # lane's own victim credit applied): a dept at its full fair
-            # share can still reclaim within itself
-            gate_b &= jax.vmap(
-                lambda qi, tr, qae: _ancestor_gate(
-                    q.parent, qi, num_levels, qae, fair_share, tr))(
-                        q_b, lane_req, qa_eff_b)
-        bias_b = W_OWN_FREED * own_incr_b.astype(jnp.float32)    # [B, N]
-        if anti:
-            dmask_b = ~anti_forbid_nodes(state, res.anti_used,
-                                         dom_static, cand_g)     # [B, N]
-            dup_b = anti_defer_lanes(state, cand_g, cand_valid)
-            if pcfg.attract_groups:
-                dmask_b = dmask_b & attract_allow_nodes(
-                    state, res.anti_used, dom_static, cand_g)
-                dup_b = dup_b | attract_defer_lanes(
-                    state, cand_g, cand_valid, res.anti_used)
-        else:
-            dmask_b = jnp.ones((B, n.n), bool)
-            dup_b = jnp.zeros((B,), bool)
-        (free2_b, dev2_b, qa2_b, qan2_b, nodes_b, devt_b, pipe_b, succ_b,
-         bind_b, devbind_b, ext2_b, extbind_b) = jax.vmap(
-            lambda gi, lane, ex_n, ex_d, ex_e, qae, sb, dm: _attempt_gang(
-                state, gi, free, dev, qae, qan, num_levels, pcfg,
-                ex_n, ex_d, lane, chain, ext_free=ext,
-                extra_extended_releasing=ex_e, score_bias=sb,
-                domain_mask=dm))(
-            cand_g, lanes, extra_b, extra_dev_b, ext_extra_b, qa_eff_b,
-            bias_b, dmask_b)
+            # an anti-deferred lane is CONFLICT-rejected (retries next
+            # chunk against the updated claimed-domain table), never
+            # terminal
+            succ_b = succ_b & ~dup_b
+            ok_pre = gate_b & succ_b                             # [B]
+            okm = ok_pre[:, None, None]
+            d_qa = jnp.where(okm, qa2_b - qa_eff_b, 0.0)
+            d_qan = jnp.where(okm, qan2_b - qan[None], 0.0)
+            cum_qa = jnp.cumsum(d_qa, axis=0)
+            cum_qan = jnp.cumsum(d_qan, axis=0)
 
-        # an anti-deferred lane is CONFLICT-rejected (retries next chunk
-        # against the updated claimed-domain table), never terminal
-        succ_b = succ_b & ~dup_b
-        ok_pre = gate_b & succ_b                                 # [B]
-        okm = ok_pre[:, None, None]
-        d_free = jnp.where(okm, free[None] - free2_b, 0.0)
-        d_bind = jnp.where(okm, bind_b, 0.0)
-        d_qa = jnp.where(okm, qa2_b - qa_eff_b, 0.0)
-        d_qan = jnp.where(okm, qan2_b - qan[None], 0.0)
-        cum_free_d = jnp.cumsum(d_free, axis=0)
-        cum_bind = jnp.cumsum(d_bind, axis=0)
-        cum_qa = jnp.cumsum(d_qa, axis=0)
-        cum_qan = jnp.cumsum(d_qan, axis=0)
+            if sparse:
+                # sparse accept: claim entries sort by node; each entry
+                # checks its node-cumulative demand against chunk-start
+                # capacity plus the lane-prefix of the sparse freed
+                # deltas gathered AT THE CLAIM SITES — the composed-
+                # capacity test without any [B, N, R] cumsum
+                req_b = g.task_req[gsafe_b, 0]                   # [B, R]
+                ent_ok = ok_pre[:, None] & (nodes_b >= 0)        # [B, T]
+                first_bad_cap, node_e, lane_e = sparse_accept_first_bad(
+                    nodes_b, ent_ok, pipe_b, req_b, free,
+                    free + n.releasing + extra, n.n,
+                    credit=lambda lane_s, nsafe: jnp.cumsum(
+                        freed_n_b[:, nsafe, :], axis=0)[
+                        lane_s, jnp.arange(lane_s.shape[0])])
+                accept = lanes < first_bad_cap                   # [B]
+                qa_comp = (qa[None] - jnp.cumsum(freed_q_b, axis=0)
+                           + cum_qa)                             # [B, Q, R]
+                # per-lane NET leftover: freed capacity the lane's own
+                # claims do not consume (freed_b - claims_b > 0 on any
+                # node).  Uniform tasks make claims a per-node entry
+                # count times the replica request — no dense [B, N, R]
+                # claim grid beyond the own-freed table that already
+                # exists.
+                nsafe_bt = jnp.where(ent_ok, nodes_b, n.n)       # [B, T]
+                cnt_bn = jnp.zeros((B, n.n + 1), req_b.dtype).at[
+                    lanes[:, None], nsafe_bt].add(1.0)[:, :n.n]  # [B, N]
+                leftover_b = jnp.any(
+                    freed_n_b - cnt_bn[:, :, None] * req_b[:, None, :]
+                    > EPS, axis=(1, 2))                          # [B]
+            else:
+                d_free = jnp.where(okm, free[None] - free2_b, 0.0)
+                d_bind = jnp.where(okm, bind_b, 0.0)
+                cum_free_d = jnp.cumsum(d_free, axis=0)
+                cum_bind = jnp.cumsum(d_bind, axis=0)
+                rel_floor_b = -(n.releasing[None] + extra_b) - EPS
+                ok_node = jnp.all(free[None] - cum_free_d >= rel_floor_b,
+                                  axis=(1, 2))
+                ok_bind = jnp.all(
+                    cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
+                    axis=(1, 2))
+                accept = ok_node & ok_bind
+                qa_comp = qa[None] - freed_q_b + cum_qa          # [B, Q, R]
+                if not reclaim:
+                    # per-lane NET leftover for the dense composed
+                    # fallback: own freed is the lane-diff of the
+                    # composed cumsum, claims are d_free — both already
+                    # materialized here
+                    own_n = freed_n_b - jnp.concatenate(
+                        [jnp.zeros_like(freed_n_b[:1]), freed_n_b[:-1]])
+                    leftover_b = jnp.any(own_n - d_free > EPS,
+                                         axis=(1, 2))            # [B]
+            ok_qa = jnp.all((qa_comp <= limit_eff_q[None] + EPS)
+                            | (cum_qa <= EPS), axis=(1, 2))
+            ok_qan = jnp.all((qan[None] + cum_qan
+                              <= quota_eff_q[None] + EPS)
+                             | (cum_qan <= EPS), axis=(1, 2))
+            accept = accept & ok_qa & ok_qan
+            if reclaim:
+                chain_b = chain[q_b]                             # [B, Q]
+                accept &= jnp.all(
+                    (qa_comp <= fair_share[None] + EPS)
+                    | ~chain_b[:, :, None], axis=(1, 2))
+            if (not sparse) and pcfg.track_devices:
+                d_dev = jnp.where(okm, dev[None] - dev2_b, 0.0)
+                d_devbind = jnp.where(okm, devbind_b, 0.0)
+                cum_dev = jnp.cumsum(d_dev, axis=0)
+                if not reclaim:
+                    own_d = freed_d_b - jnp.concatenate(
+                        [jnp.zeros_like(freed_d_b[:1]), freed_d_b[:-1]])
+                    leftover_b |= jnp.any(own_d - d_dev > EPS,
+                                          axis=(1, 2))
+                accept &= jnp.all(
+                    dev[None] - cum_dev
+                    >= -(n.device_releasing[None] + extra_dev_b) - EPS,
+                    axis=(1, 2))
+                accept &= jnp.all(
+                    jnp.cumsum(d_devbind, axis=0)
+                    <= jnp.maximum(dev[None], 0.0) + EPS, axis=(1, 2))
+            if (not sparse) and pcfg.extended:
+                d_ext = jnp.where(okm, ext[None] - ext2_b, 0.0)
+                cum_ext = jnp.cumsum(d_ext, axis=0)
+                if not reclaim:
+                    own_e = freed_e_b - jnp.concatenate(
+                        [jnp.zeros_like(freed_e_b[:1]), freed_e_b[:-1]])
+                    leftover_b |= jnp.any(own_e - d_ext > EPS,
+                                          axis=(1, 2))
+                accept &= jnp.all(
+                    ext[None] - cum_ext
+                    >= -(n.extended_releasing[None] + ext_extra_b) - EPS,
+                    axis=(1, 2))
+                accept &= jnp.all(
+                    jnp.cumsum(jnp.where(okm, extbind_b, 0.0), axis=0)
+                    <= jnp.maximum(ext[None], 0.0) + EPS, axis=(1, 2))
 
-        rel_floor_b = -(n.releasing[None] + extra_b) - EPS
-        ok_node = jnp.all(free[None] - cum_free_d >= rel_floor_b,
-                          axis=(1, 2))
-        ok_bind = jnp.all(cum_bind <= jnp.maximum(free[None], 0.0) + EPS,
-                          axis=(1, 2))
-        qa_comp = qa[None] - freed_q_b + cum_qa                  # [B, Q, R]
-        ok_qa = jnp.all((qa_comp <= limit_eff_q[None] + EPS)
-                        | (cum_qa <= EPS), axis=(1, 2))
-        ok_qan = jnp.all((qan[None] + cum_qan <= quota_eff_q[None] + EPS)
-                         | (cum_qan <= EPS), axis=(1, 2))
-        accept = ok_node & ok_bind & ok_qa & ok_qan
-        if reclaim:
-            chain_b = chain[q_b]                                 # [B, Q]
-            accept &= jnp.all(
-                (qa_comp <= fair_share[None] + EPS)
-                | ~chain_b[:, :, None], axis=(1, 2))
-        if pcfg.track_devices:
-            d_dev = jnp.where(okm, dev[None] - dev2_b, 0.0)
-            d_devbind = jnp.where(okm, devbind_b, 0.0)
-            cum_dev = jnp.cumsum(d_dev, axis=0)
-            accept &= jnp.all(
-                dev[None] - cum_dev
-                >= -(n.device_releasing[None] + extra_dev_b) - EPS,
-                axis=(1, 2))
-            accept &= jnp.all(
-                jnp.cumsum(d_devbind, axis=0)
-                <= jnp.maximum(dev[None], 0.0) + EPS, axis=(1, 2))
-        if pcfg.extended:
-            d_ext = jnp.where(okm, ext[None] - ext2_b, 0.0)
-            cum_ext = jnp.cumsum(d_ext, axis=0)
-            accept &= jnp.all(
-                ext[None] - cum_ext
-                >= -(n.extended_releasing[None] + ext_extra_b) - EPS,
-                axis=(1, 2))
-            accept &= jnp.all(
-                jnp.cumsum(jnp.where(okm, extbind_b, 0.0), axis=0)
-                <= jnp.maximum(ext[None], 0.0) + EPS, axis=(1, 2))
+            # ---- strict accept prefix -----------------------------------
+            fail_own = cand_valid & ~(ok_pre & accept)           # [B]
+            if reclaim:
+                prev_lo = jnp.zeros((B,), bool)
+            else:
+                # LEFTOVER DEMOTION (preempt exactness): a committing
+                # lane whose victims free MORE than its own claims
+                # consume leaves net capacity the sequential scan would
+                # expose to every later preemptor — but a later lane's
+                # optimistic solve never saw it (sparse: own pool only;
+                # dense: chunk-start free without earlier claims), so
+                # its placement can silently diverge where the accept's
+                # over-subscription check has nothing to catch.  Lanes
+                # after the first accepted leftover-producing lane are
+                # demoted to conflict-retry; next chunk they re-run as
+                # the LEADING lane, where inputs compose exactly and
+                # the solve is bias-free (reference-exact).  Leftover
+                # is rare in the steady state (a preemptor lands on its
+                # own victims' capacity and consumes it), so chunks
+                # stay wide; the demotion count is exported per cycle.
+                lo_i = (ok_pre & accept & leftover_b).astype(jnp.int32)
+                prev_lo = (jnp.cumsum(lo_i) - lo_i) > 0
+            bad = fail_own | (cand_valid & prev_lo)              # [B]
+            bad_cum = jnp.cumsum(bad.astype(jnp.int32))
+            take = cand_valid & (bad_cum == 0)                   # [B]
+            demoted = cand_valid & prev_lo & ok_pre & accept     # [B]
+            # Only a GATE/placement failure of the first bad lane is
+            # final — its inputs composed exactly (every earlier valid
+            # lane took), and own-queue exclusion is exact here, so the
+            # failure is genuine (insufficient admissible victims,
+            # capacity, or queue gates) — never a range artifact.  An
+            # accept failure there is a cross-lane capacity CONFLICT:
+            # the lane retries next chunk, where, as the leading lane,
+            # its accept is self-consistent.
+            #
+            # TERMINATION INVARIANT (the fuel bound relies on it): every
+            # chunk retires >=1 lane, because a LEADING valid lane's
+            # accept is implied by ok_pre — each accept component (node
+            # floors vs its own extra pool, bind vs chunk-start idle,
+            # queue caps, the reclaim fair-share term) is already
+            # enforced by gate_b/_attempt_gang when no earlier lane
+            # contributed deltas.  If you add an accept-ONLY check, also
+            # gate it in gate_b, or the loop can spin identical chunks
+            # until fuel exhausts.
+            first_bad = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
+            if sparse:
+                # the optimistic own-pool solve hides earlier lanes'
+                # freed capacity: a non-leading lane's gate/placement
+                # failure may be that artifact, so only the LEADING
+                # valid lane (whose inputs compose exactly) fails
+                # terminally — everything else conflict-retries
+                first_fail = first_bad & ~ok_pre & ~dup_b & lead
+            else:
+                # a lane demoted by an earlier leftover had polluted
+                # inputs — its failure is never terminal
+                first_fail = first_bad & ~ok_pre & ~dup_b & ~prev_lo
+            any_take = jnp.any(take)
+            star = jnp.argmax(jnp.where(take, lanes, -1))
+            victims = (lane_of_pod <= star) & any_take
+            # per-queue consumed pointers: the max committed budget among
+            # accepted lanes allowed to evict from that queue
+            if reclaim:
+                M_v = jnp.max(jnp.where(take[None, :] & may,
+                                        K_b[None, :], -1), axis=1)  # [Q]
+            else:
+                # accepted lanes advance their OWN queue's pointer to
+                # their per-queue watermark
+                M_v = jax.ops.segment_max(
+                    jnp.where(take & cand_valid, K_wm, -1),
+                    jnp.where(cand_valid, q_b, Q),
+                    num_segments=Q + 1)[:Q]
+            c2 = jnp.maximum(c, M_v)
 
-        # ---- strict accept prefix ---------------------------------------
-        bad = cand_valid & ~(ok_pre & accept)                    # [B]
-        bad_cum = jnp.cumsum(bad.astype(jnp.int32))
-        take = cand_valid & (bad_cum == 0)                       # [B]
-        # Only a GATE/placement failure of the first bad lane is final —
-        # its inputs composed exactly (every earlier valid lane took),
-        # and own-queue exclusion is exact here, so the failure is
-        # genuine (insufficient admissible victims, capacity, or queue
-        # gates) — never a range artifact.  An accept failure there is a
-        # cross-lane capacity CONFLICT: the lane retries next chunk,
-        # where, as the leading lane, its accept is self-consistent.
-        #
-        # TERMINATION INVARIANT (the fuel bound relies on it): every
-        # chunk retires >=1 lane, because a LEADING valid lane's accept
-        # is implied by ok_pre — each accept component (node floors vs
-        # its own extra pool, bind vs chunk-start idle, queue caps, the
-        # reclaim fair-share term) is already enforced by
-        # gate_b/_attempt_gang when no earlier lane contributed deltas.
-        # If you add an accept-ONLY check, also gate it in gate_b, or
-        # the loop can spin identical chunks until fuel exhausts.
-        first_bad = bad & ((bad_cum - bad.astype(jnp.int32)) == 0)
-        first_fail = first_bad & ~ok_pre & ~dup_b
-        any_take = jnp.any(take)
-        star = jnp.argmax(jnp.where(take, lanes, -1))
-        victims = (lane_of_pod <= star) & any_take
-        # per-queue consumed pointers: the max committed budget among
-        # accepted lanes allowed to evict from that queue
-        if reclaim:
-            M_v = jnp.max(jnp.where(take[None, :] & may,
-                                    K_b[None, :], -1), axis=1)   # [Q]
-        else:
-            # accepted lanes advance their OWN queue's pointer to their
-            # per-queue watermark
-            M_v = jax.ops.segment_max(
-                jnp.where(take & cand_valid, K_wm, -1),
-                jnp.where(cand_valid, q_b, Q),
-                num_segments=Q + 1)[:Q]
-        c2 = jnp.maximum(c, M_v)
+            w = take.astype(free.dtype)
+            sel = lambda arr, base_v: jnp.where(any_take, arr[star],
+                                                base_v)
+            if sparse:
+                # commits reconstruct capacity deltas from the sparse
+                # entries (claims) and the per-lane own freed (pools) —
+                # the union of accepted DISJOINT lanes is a plain sum
+                take_e = take[lane_e] & ent_ok.ravel()
+                upd = jnp.zeros((n.n + 1, R_), free.dtype).at[
+                    node_e].add(
+                    jnp.where(take_e[:, None], req_b[lane_e], 0.0),
+                    mode="drop")
+                new_free = free - upd[:n.n]
+                new_extra = extra + jnp.einsum("b,bnr->nr", w, freed_n_b)
+                new_qa = (qa - jnp.einsum("b,bqr->qr", w, freed_q_b)
+                          + jnp.einsum("b,bqr->qr", w, d_qa))
+            else:
+                new_free = free - jnp.einsum("b,bnr->nr", w, d_free)
+                new_extra = sel(extra_b, extra)
+                new_qa = (sel(qa_eff_b, qa)
+                          + jnp.einsum("b,bqr->qr", w, d_qa))
+            res = res.replace(
+                free=new_free,
+                device_free=(dev - jnp.einsum(
+                    "b,bnd->nd", w,
+                    jnp.where(okm, dev[None] - dev2_b, 0.0))
+                    if (not sparse) and pcfg.track_devices else dev),
+                extended_free=(ext - jnp.einsum(
+                    "b,bne->ne", w,
+                    jnp.where(okm, ext[None] - ext2_b, 0.0))
+                    if (not sparse) and pcfg.extended else ext),
+                releasing_extra=new_extra,
+                device_releasing_extra=(sel(extra_dev_b, extra_dev)
+                                        if track_dev else extra_dev),
+                extended_releasing_extra=(sel(ext_extra_b, ext_extra)
+                                          if track_ext else ext_extra),
+                queue_allocated=new_qa,
+                queue_allocated_nonpreemptible=(
+                    qan + jnp.einsum("b,bqr->qr", w, d_qan)),
+                placements=res.placements.at[cand_g].set(
+                    jnp.where(take[:, None], nodes_b,
+                              res.placements[cand_g])),
+                placement_device=res.placement_device.at[cand_g].set(
+                    jnp.where(take[:, None], devt_b,
+                              res.placement_device[cand_g])),
+                pipelined=res.pipelined.at[cand_g].set(
+                    jnp.where(take[:, None], pipe_b,
+                              res.pipelined[cand_g])),
+                allocated=res.allocated.at[cand_g].set(
+                    res.allocated[cand_g] | take),
+                attempted=res.attempted.at[cand_g].set(
+                    res.attempted[cand_g] | take | first_fail),
+                fit_reason=res.fit_reason.at[cand_g].set(
+                    jnp.where(first_fail, 3, res.fit_reason[cand_g])),
+                victim=res.victim | victims,
+                wavefront_stats=res.wavefront_stats
+                .at[ROW, 0].add(1)
+                .at[ROW, 1].add(jnp.sum(cand_valid.astype(jnp.int32)))
+                .at[ROW, 2].add(B)
+                .at[ROW, 4].add(jnp.sum(demoted.astype(jnp.int32))),
+            )
+            if anti:
+                res = res.replace(anti_used=anti_mark_placements(
+                    state, res.anti_used, dom_static, cand_g,
+                    jnp.where(take[:, None], nodes_b, -1), take))
+            done_b = take | first_fail
+            remaining = remaining.at[cand_g].set(
+                remaining[cand_g] & ~done_b)
+            if depth is not None:
+                q_att = q_att + jax.ops.segment_sum(
+                    done_b.astype(jnp.int32), q_b, num_segments=Q)
+                remaining = remaining & (q_att[gq] < depth)
+            if reclaim:
+                # live strategy-viability drop (see the sequential path)
+                qa_l = res.queue_allocated
+                under_g = jax.vmap(
+                    lambda qi, tr: _ancestor_gate(
+                        q.parent, qi, num_levels, qa_l, q.quota, tr))(
+                            gq, task_req_g)
+                lqs2 = jnp.maximum(lq_tab, 0)
+                no_lq = lq_tab < 0
+                over_fs_vc = no_lq | jnp.any(
+                    qa_l[lqs2] > fair_share[lqs2] + EPS, -1)
+                over_qt_vc = no_lq | jnp.any(
+                    qa_l[lqs2] > quota_eff_q[lqs2] + EPS, -1)
+                diff = (qidx[:, None] != qidx[None, :])
+                has_v = (cnt_q > 0)[:, None] & diff
+                ev_fs_c = jnp.any(has_v & over_fs_vc, axis=0)
+                ev_qt_c = jnp.any(has_v & over_qt_vc, axis=0)
+                remaining = remaining & (
+                    ev_fs_c[gq] | (under_g & ev_qt_c[gq]))
+            return res, remaining, c2, q_att, fuel - 1
 
-        w = take.astype(free.dtype)
-        sel = lambda arr, base_v: jnp.where(any_take, arr[star], base_v)
-        res = res.replace(
-            free=free - jnp.einsum("b,bnr->nr", w, d_free),
-            device_free=(dev - jnp.einsum(
-                "b,bnd->nd", w, jnp.where(okm, dev[None] - dev2_b, 0.0))
-                if pcfg.track_devices else dev),
-            extended_free=(ext - jnp.einsum(
-                "b,bne->ne", w, jnp.where(okm, ext[None] - ext2_b, 0.0))
-                if pcfg.extended else ext),
-            releasing_extra=sel(extra_b, extra),
-            device_releasing_extra=sel(extra_dev_b, extra_dev),
-            extended_releasing_extra=sel(ext_extra_b, ext_extra),
-            queue_allocated=(sel(qa_eff_b, qa)
-                             + jnp.einsum("b,bqr->qr", w, d_qa)),
-            queue_allocated_nonpreemptible=(
-                qan + jnp.einsum("b,bqr->qr", w, d_qan)),
-            placements=res.placements.at[cand_g].set(
-                jnp.where(take[:, None], nodes_b,
-                          res.placements[cand_g])),
-            placement_device=res.placement_device.at[cand_g].set(
-                jnp.where(take[:, None], devt_b,
-                          res.placement_device[cand_g])),
-            pipelined=res.pipelined.at[cand_g].set(
-                jnp.where(take[:, None], pipe_b,
-                          res.pipelined[cand_g])),
-            allocated=res.allocated.at[cand_g].set(
-                res.allocated[cand_g] | take),
-            attempted=res.attempted.at[cand_g].set(
-                res.attempted[cand_g] | take | first_fail),
-            fit_reason=res.fit_reason.at[cand_g].set(
-                jnp.where(first_fail, 3, res.fit_reason[cand_g])),
-            victim=res.victim | victims,
-        )
-        if anti:
-            res = res.replace(anti_used=anti_mark_placements(
-                state, res.anti_used, dom_static, cand_g,
-                jnp.where(take[:, None], nodes_b, -1), take))
-        done_b = take | first_fail
-        remaining = remaining.at[cand_g].set(
-            remaining[cand_g] & ~done_b)
-        if depth is not None:
-            q_att = q_att + jax.ops.segment_sum(
-                done_b.astype(jnp.int32), q_b, num_segments=Q)
-            remaining = remaining & (q_att[gq] < depth)
-        if reclaim:
-            # live strategy-viability drop (see the sequential path)
-            qa_l = res.queue_allocated
-            under_g = jax.vmap(
-                lambda qi, tr: _ancestor_gate(
-                    q.parent, qi, num_levels, qa_l, q.quota, tr))(
-                        gq, task_req_g)
-            lqs2 = jnp.maximum(lq_tab, 0)
-            no_lq = lq_tab < 0
-            over_fs_vc = no_lq | jnp.any(
-                qa_l[lqs2] > fair_share[lqs2] + EPS, -1)
-            over_qt_vc = no_lq | jnp.any(
-                qa_l[lqs2] > quota_eff_q[lqs2] + EPS, -1)
-            diff = (qidx[:, None] != qidx[None, :])
-            has_v = (cnt_q > 0)[:, None] & diff
-            ev_fs_c = jnp.any(has_v & over_fs_vc, axis=0)
-            ev_qt_c = jnp.any(has_v & over_qt_vc, axis=0)
-            remaining = remaining & (
-                ev_fs_c[gq] | (under_g & ev_qt_c[gq]))
-        return res, remaining, c2, q_att, fuel - 1
+        def run(res0):
+            if fell_back:
+                # runtime overflow of the compact unit tables — counted
+                # so the sparse-path fallback rate is observable
+                res0 = res0.replace(
+                    wavefront_stats=res0.wavefront_stats
+                    .at[ROW, 3].add(1))
+            res, _, _, _, fuel_left = lax.while_loop(
+                lambda cr: jnp.any(cr[1]) & (cr[4] > 0), chunk,
+                (res0, remaining0, jnp.full((Q,), -1, jnp.int32),
+                 jnp.zeros((Q,), jnp.int32), jnp.asarray(G, jnp.int32)))
+            if _DEBUG_CHUNKS:
+                # stash the chunk count in the last fit_reason slot
+                # (scratch diagnostics only — that slot is snapshot
+                # padding in practice)
+                res = res.replace(fit_reason=res.fit_reason.at[-1].set(
+                    jnp.asarray(G, jnp.int32) - fuel_left))
+            return res
 
-    res, _, _, _, fuel_left = lax.while_loop(
-        lambda cr: jnp.any(cr[1]) & (cr[4] > 0), chunk,
-        (result, remaining0, jnp.full((Q,), -1, jnp.int32),
-         jnp.zeros((Q,), jnp.int32), jnp.asarray(G, jnp.int32)))
-    if _DEBUG_CHUNKS:
-        # stash the chunk count in the last fit_reason slot (scratch
-        # diagnostics only — that slot is snapshot padding in practice)
-        res = res.replace(fit_reason=res.fit_reason.at[-1].set(
-            jnp.asarray(G, jnp.int32) - fuel_left))
-    return res
+        return run
+
+    if not sparse_able:
+        return make_run(False, False)(result)
+    if KU >= M:
+        # no queue can ever expose more units than running pods exist:
+        # the dense fallback is statically unreachable, so skip the
+        # cond (small tier-1 shapes trace ONE loop, not two)
+        return make_run(True, False)(result)
+    cnt_units_q = jax.ops.segment_sum(
+        has_leaf.astype(jnp.int32), jnp.where(has_leaf, leaf_safe, Q),
+        num_segments=Q + 1)[:Q]
+    return lax.cond(jnp.any(cnt_units_q > KU),
+                    make_run(False, True), make_run(True, False), result)
 
 
 #: scratch diagnostics flag (set True to expose chunk counts)

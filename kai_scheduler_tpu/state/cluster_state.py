@@ -586,6 +586,10 @@ class SnapshotIndex:
     #: valid childless queues — preempt chunk width auto-tunes with
     #: this (preemptors spread across many queues fill wider chunks)
     num_leaf_queues: int = 0
+    #: gangs with at least one pending task — the live preemptor
+    #: spread; the Session clamps the victim wavefront's lane width to
+    #: it so junk lanes stop paying freed-pool cost (-1 = unknown)
+    num_pending_gangs: int = -1
     #: emitted term-row count (the anti_used table's row dimension is
     #: sized from the state arrays; this is informational)
     num_anti_groups: int = 0
@@ -1747,6 +1751,7 @@ def build_snapshot(
         num_leaf_queues=int(
             (q_valid & ~np.isin(np.arange(Q),
                                 q_parent[q_parent >= 0])).sum()),
+        num_pending_gangs=int(gk["task_valid"].any(axis=1).sum()),
         claims_by_pod={p.name: list(p.resource_claims)
                        for p in all_pend if p.resource_claims},
         host_tables={

@@ -1278,6 +1278,8 @@ class IncrementalSnapshotter:
                 (qt["q_valid"] & ~np.isin(
                     np.arange(Q),
                     qt["q_parent"][qt["q_parent"] >= 0])).sum()),
+            num_pending_gangs=int(
+                np.asarray(gangs.task_valid).any(axis=1).sum()),
             claims_by_pod={},
             host_tables={
                 "task_portion": self._const["task_portion"],
@@ -1481,6 +1483,7 @@ class IncrementalSnapshotter:
                       "extended_keys", "has_reclaim_minruntime",
                       "has_anti_groups", "has_attract_groups",
                       "max_queue_depth", "num_leaf_queues",
+                      "num_pending_gangs",
                       "num_anti_groups", "claims_by_pod",
                       "dense_feasibility"):
             if getattr(mine_i, field) != getattr(ref_i, field):

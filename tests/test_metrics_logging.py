@@ -45,6 +45,45 @@ def test_scheduler_cycle_populates_metrics():
     assert "kai_queue_fair_share" in metrics.registry.render()
 
 
+def test_victim_wavefront_gauges_populated():
+    """PR-5 observability: a cycle whose preempt action runs chunks
+    must surface chunk count, lane occupancy, and the sparse-path
+    fallback count through /metrics (``wavefront_stats`` rides the
+    packed commit transfer)."""
+    from kai_scheduler_tpu.apis import types as apis
+    from kai_scheduler_tpu.framework import metrics
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=8))]
+    low = apis.PodGroup("low", queue="q", min_member=1, priority=1,
+                        last_start_timestamp=0.0)
+    high = apis.PodGroup("high", queue="q", min_member=2, priority=9,
+                         creation_timestamp=1.0)
+    pods = [apis.Pod(f"v{i}", "low", apis.ResourceVec(1, 1, 4),
+                     status=apis.PodStatus.RUNNING, node="n0")
+            for i in range(8)]
+    pods += [apis.Pod(f"h{i}", "high", apis.ResourceVec(2, 1, 4),
+                      creation_timestamp=1.0) for i in range(2)]
+    cluster = Cluster.from_objects(nodes, queues, [low, high], pods)
+    cluster.now = 100.0
+    res = Scheduler().run_once(cluster)
+    assert len(res.evictions) > 0          # preempt actually fired
+    assert metrics.victim_wavefront_chunks.value("preempt") >= 1
+    occ = metrics.victim_wavefront_lane_occupancy.value("preempt")
+    assert 0 < occ <= 1.0
+    assert metrics.victim_wavefront_sparse_fallbacks.value("preempt") == 0
+    assert (metrics.victim_wavefront_leftover_demotions.value("preempt")
+            >= 0)
+    text = metrics.registry.render()
+    for name in ("kai_victim_wavefront_chunks",
+                 "kai_victim_wavefront_lane_occupancy",
+                 "kai_victim_wavefront_sparse_fallbacks",
+                 "kai_victim_wavefront_leftover_demotions"):
+        assert name in text
+
+
 def test_infra_logger_verbosity_and_scope(capsys):
     log = InfraLogger(name="kai-test", verbosity=3)
     scoped = log.with_scope(session=7, action="allocate")
