@@ -15,6 +15,9 @@ pending pods**, p99 cycle latency against the driver's 50 ms bar
   preempt       512 queues × 1 boosted preemptor @ 10k nodes (the
                 sparse victim-wavefront hot path; quick alias of
                 preempt_many_queues)
+  phases        kai-trace per-phase cycle attribution (snapshot/upload/
+                solve-dispatch/device-wait/host-decode/commit) @ 10k
+                nodes × 50k pods, 1% journaled churn
   headline      10k nodes × 50k pods allocate
   e2e/e2e_alloc full cycle (snapshot→actions→commit), saturated /
                 allocate-heavy shapes
@@ -250,7 +253,8 @@ def bench_headline_full(iters: int) -> dict:
                      ("topology", bench_topology),
                      ("reclaim", bench_reclaim),
                      ("preempt_many_queues", bench_preempt_many_queues),
-                     ("churn", bench_churn)):
+                     ("churn", bench_churn),
+                     ("phases", bench_phases)):
         try:
             r = fn(max(3, iters // 2))
             extra[name] = {"p99_ms": r["value"],
@@ -428,6 +432,27 @@ def bench_preempt_many_queues(iters: int) -> dict:
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
 
 
+def _churn_cluster(cluster, rng, frac: float,
+                   num_nodes: int = 10_000) -> None:
+    """Journaled churn (evict half / rebind half / tick) through the
+    mutation paths the cluster hub marks, so the incremental refresh
+    can patch — shared by the churn and phases benches."""
+    from kai_scheduler_tpu.apis import types as apis
+    k = max(1, int(len(cluster.pods) * frac / 2))
+    running = [p.name for p in cluster.pods.values()
+               if p.status == apis.PodStatus.RUNNING][:k]
+    for nm in running:
+        cluster.evict_pod(nm)
+    pending = [p for p in cluster.pods.values()
+               if p.status == apis.PodStatus.PENDING][:k]
+    for p in pending:
+        try:
+            cluster.bind_pod(p.name, f"node-{rng.integers(0, num_nodes)}")
+        except RuntimeError:
+            pass  # node full — the churn mix, not the refresh, varies
+    cluster.tick()
+
+
 def bench_churn(iters: int) -> dict:
     """Snapshot-refresh latency vs churn — the incremental snapshot
     engine (state/incremental.py) against the full ``build_snapshot``
@@ -468,23 +493,6 @@ def bench_churn(iters: int) -> dict:
     full_p99 = _p99(full_times)
 
     rng = np.random.default_rng(0)
-
-    def churn(frac: float) -> None:
-        k = max(1, int(len(cluster.pods) * frac / 2))
-        running = [p.name for p in cluster.pods.values()
-                   if p.status == apis.PodStatus.RUNNING][:k]
-        for nm in running:
-            cluster.evict_pod(nm)
-        pending = [p for p in cluster.pods.values()
-                   if p.status == apis.PodStatus.PENDING][:k]
-        for p in pending:
-            try:
-                cluster.bind_pod(p.name,
-                                 f"node-{rng.integers(0, 10_000)}")
-            except RuntimeError:
-                pass  # node full — the churn mix, not the refresh, varies
-        cluster.tick()
-
     extra: dict = {"full_rebuild_p99_ms": round(full_p99, 1)}
     p99_1pct = None
     for frac, label in ((0.001, "0.1pct"), (0.01, "1pct"),
@@ -492,7 +500,7 @@ def bench_churn(iters: int) -> dict:
         times = []
         before = snap.stats.patched
         for _ in range(max(5, iters)):
-            churn(frac)
+            _churn_cluster(cluster, rng, frac)
             t0 = time.perf_counter()
             snap.refresh(cluster, now=cluster.now)
             times.append(time.perf_counter() - t0)
@@ -508,6 +516,71 @@ def bench_churn(iters: int) -> dict:
                        f"{extra['full_rebuild_p99_ms']} ms full rebuild)"),
             "value": round(p99_1pct, 3), "unit": "ms",
             "vs_baseline": round(50.0 / max(p99_1pct, 1e-9), 3),
+            "extra": extra}
+
+
+def bench_phases(iters: int, *, num_nodes: int = 10_000,
+                 num_gangs: int = 6250, tasks_per_gang: int = 8) -> dict:
+    """Measured per-cycle phase attribution at the headline shape —
+    the kai-trace breakdown (snapshot / upload / solve-dispatch /
+    device-wait / host-decode / commit) of a full production cycle at
+    10k nodes × 50k pods with 1% journaled churn per cycle, so the
+    incremental snapshotter stays on the patch path and "upload" is the
+    real changed-leaves transfer.  Phases are contiguous checkpoints on
+    one clock (framework/scheduler.py), so they sum to the cycle wall
+    time by construction; ``coverage`` reports that sum / measured wall
+    (the acceptance bar is within 10%).  BENCH_r06+ records THIS
+    measured attribution where earlier rounds could only subtract an
+    estimated link-floor constant."""
+    import numpy as np
+
+    from kai_scheduler_tpu.framework.scheduler import Scheduler
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    from kai_scheduler_tpu.state import make_cluster
+
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=num_nodes, node_accel=8.0, num_gangs=num_gangs,
+        tasks_per_gang=tasks_per_gang, running_fraction=0.5)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    sched = Scheduler()
+    sched.run_once(cluster)  # compile + warm the incremental cache
+    rng = np.random.default_rng(0)
+
+    walls: list[float] = []
+    acc: dict[str, list[float]] = {}
+    for _ in range(max(5, iters)):
+        _churn_cluster(cluster, rng, 0.01, num_nodes)
+        t0 = time.perf_counter()
+        res = sched.run_once(cluster)
+        walls.append(time.perf_counter() - t0)
+        for k, v in res.phase_seconds.items():
+            acc.setdefault(k, []).append(v)
+    wall_mean = float(np.mean(walls))
+    phases_ms = {k: round(float(np.mean(v)) * 1e3, 2)
+                 for k, v in acc.items()}
+    phase_sum = sum(float(np.mean(v)) for v in acc.values())
+    wall_p99 = _p99(walls)
+    snap = sched._snapshotter
+    extra = {
+        "phases_ms": phases_ms,
+        "wall_mean_ms": round(wall_mean * 1e3, 2),
+        "phase_sum_ms": round(phase_sum * 1e3, 2),
+        # phases are contiguous checkpoints, so this is ~1.0 by
+        # construction — reported so the artifact PROVES the 10% bar
+        "coverage": round(phase_sum / max(wall_mean, 1e-12), 4),
+        "snapshot_mode": (dict(snap.stats.last)
+                          if snap is not None else {}),
+        "patched_cycles": (snap.stats.patched
+                           if snap is not None else 0),
+        "fallbacks": (dict(snap.stats.fallbacks)
+                      if snap is not None else {}),
+    }
+    return {"metric": (f"cycle phase attribution p99 @ {num_nodes} "
+                       f"nodes x {num_gangs * tasks_per_gang} pods, "
+                       "1% churn (snapshot/upload/solve-dispatch/"
+                       "device-wait/host-decode/commit)"),
+            "value": round(wall_p99, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / max(wall_p99, 1e-9), 3),
             "extra": extra}
 
 
@@ -620,6 +693,7 @@ CONFIGS = {
     "preempt": bench_preempt_many_queues,
     "preempt_many_queues": bench_preempt_many_queues,
     "churn": bench_churn,
+    "phases": bench_phases,
     "headline": bench_headline,
     "e2e": bench_e2e,
     "e2e_alloc": bench_e2e_alloc,

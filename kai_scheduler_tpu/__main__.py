@@ -103,6 +103,8 @@ def main(argv: list[str] | None = None) -> int:
             "open_seconds": round(result.open_seconds, 4),
             "commit_seconds": round(result.commit_seconds, 4),
             "total_seconds": round(result.session_seconds, 4),
+            "phase_seconds": {k: round(v, 4)
+                              for k, v in result.phase_seconds.items()},
         }))
         return 0
 

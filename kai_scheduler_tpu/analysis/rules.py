@@ -9,6 +9,7 @@ Code families (stable — suppressions and baselines reference them):
 * ``KAI031-KAI032`` recompile hazards
 * ``KAI041``        determinism hazards
 * ``KAI051-KAI052`` generic hygiene
+* ``KAI061``        observability discipline (tracer calls in traces)
 
 "Jit region" is the transitive call graph grown from the package's
 ``jax.jit`` entry points (see ``callgraph.py``); host-only code is
@@ -36,6 +37,15 @@ _NP_DTYPE_ATTRS = frozenset({
 
 #: method names whose call on an array forces a device→host sync
 _SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+#: the kai-trace recording surface (runtime/tracing.py CycleTracer) —
+#: a span call inside a jit-traced function executes at TRACE time, so
+#: it would record compilation (once) instead of execution (per cycle)
+#: and silently measure nothing
+_TRACER_METHODS = frozenset({
+    "span", "cycle", "add_span", "device_sync", "begin_cycle",
+    "end_cycle",
+})
 
 #: jnp functions whose output shape depends on input *values* — inside
 #: jit they either fail to trace or (via fallback paths) force
@@ -586,6 +596,59 @@ def _unordered_iteration(ctx: RuleCtx) -> Iterator[Finding]:
                 "buffers, scheduling signatures, journals) loses "
                 "determinism — wrap in sorted()",
                 _in_function(ctx, it) or "")
+
+
+# ---------------------------------------------------------------------------
+# KAI061 — observability discipline
+
+@rule(
+    "KAI061", "tracer/span call inside the jit region (records trace "
+    "time, not run time)",
+    bad="""
+import jax
+
+from kai_scheduler_tpu.runtime.tracing import CycleTracer
+
+tracer = CycleTracer()
+
+
+@jax.jit
+def op(x):
+    with tracer.span("solve"):
+        return x + 1
+""",
+    good="""
+import jax
+
+from kai_scheduler_tpu.runtime.tracing import CycleTracer
+
+tracer = CycleTracer()
+
+
+@jax.jit
+def op(x):
+    return x + 1
+
+
+def run(x):
+    with tracer.span("solve"):
+        return op(x)
+""")
+def _tracer_in_jit(ctx: RuleCtx) -> Iterator[Finding]:
+    for qual, node in _jit_body(ctx):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TRACER_METHODS):
+            continue
+        base = _dotted(node.func.value)
+        if base is not None and "tracer" in base.lower():
+            yield ctx.finding(
+                "KAI061", node,
+                f".{node.func.attr}() on `{base}` inside a compiled op "
+                f"runs at trace time — the span would bracket "
+                f"compilation, not execution, and its timestamps would "
+                f"be meaningless.  Instrument around the dispatch on "
+                f"the host path instead", qual)
 
 
 # ---------------------------------------------------------------------------

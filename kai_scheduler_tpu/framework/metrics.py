@@ -1,8 +1,19 @@
 """The scheduler's metric catalog — ref ``pkg/scheduler/metrics/metrics.go:39-58``
-and ``docs/metrics/METRICS.md``, same metric names (kai_ prefix)."""
+and this repo's generated ``docs/metrics/METRICS.md``, same metric
+names (kai_ prefix).
+
+Every metric registers HERE (one module, one registry) so the catalog
+doc can be generated — and drift-checked — from a single source:
+
+    python -m kai_scheduler_tpu.framework.metrics > docs/metrics/METRICS.md
+
+``tests/test_metrics_catalog.py`` asserts the committed doc equals the
+registry exactly (name, type, labels, help); ``scripts/lint.py`` runs
+the same check jax-free by AST-parsing this module's registrations.
+"""
 from __future__ import annotations
 
-from ..utils.metrics import Registry
+from ..utils.metrics import Registry, render_catalog
 
 registry = Registry()
 
@@ -64,3 +75,38 @@ victim_wavefront_leftover_demotions = registry.gauge(
     "its claims consumed; the same lane re-demoted in a later chunk "
     "counts again — the gauge measures serialization pressure, not "
     "distinct lanes)", label_names=("action",))
+# kai-trace phase attribution (runtime/tracing.py): the cycle timeline
+# partitioned into contiguous phases — snapshot (host build/patch),
+# upload (changed-leaves transfer DISPATCH; device_put is async, so the
+# transfer itself overlaps the solve), solve_dispatch (async kernel
+# dispatch), device_wait (first blocking sync: link + device + any
+# still-inflight transfer time), host_decode (tensors ->
+# BindRequests/evictions), commit (API writes, status, bookkeeping).
+# The phases sum to the cycle wall time.
+cycle_phase_seconds = registry.histogram(
+    "kai_cycle_phase_seconds",
+    "Per-phase scheduling cycle latency (phases partition the cycle "
+    "wall time; device_wait brackets the first blocking transfer)",
+    label_names=("phase",))
+# continuous profiler push counters (runtime/profiling.py) — were bare
+# instance attributes invisible to /metrics
+profiler_pushed_windows = registry.counter(
+    "kai_profiler_pushed_windows_total",
+    "Continuous-profiler windows pushed to the ingest server")
+profiler_push_errors = registry.counter(
+    "kai_profiler_push_errors_total",
+    "Continuous-profiler window pushes that failed (swallowed after "
+    "counting — a profiling sink never affects scheduling)")
+
+
+def catalog() -> list[dict]:
+    """Every registered metric as ``{name, type, labels, help}`` — the
+    source of truth for ``docs/metrics/METRICS.md``."""
+    return sorted(({"name": m.name, "type": m.kind,
+                    "labels": list(m.label_names), "help": m.help}
+                   for m in registry.metrics()),
+                  key=lambda r: r["name"])
+
+
+if __name__ == "__main__":
+    print(render_catalog(catalog()), end="")

@@ -29,6 +29,8 @@ from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
 from ..ops.stale import stale_gang_eviction
 from ..ops.victims import run_victim_action, run_victim_action_jit
 from ..runtime.cluster import Cluster
+from ..runtime.events import DecisionLog
+from ..runtime.tracing import CycleTracer
 from .session import Session, SessionConfig
 
 stale_eviction_jit = functools.partial(jax.jit, static_argnames=(
@@ -90,14 +92,20 @@ class CycleResult:
     tensors: AllocationResult | None = None
     #: action name -> wall seconds (ref per-action latency metrics).
     #: NOTE: kernels dispatch async — an action's time is dispatch cost;
-    #: device execution overlaps and is absorbed by ``commit_seconds``
-    #: (the first host transfer syncs).
+    #: device execution overlaps and is absorbed by the ``device_wait``
+    #: phase (the first host transfer syncs).
     action_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
     session_seconds: float = 0.0
     #: Session.open wall seconds (host snapshot build + DRF dispatch)
     open_seconds: float = 0.0
     #: tensors→BindRequests/evictions + API writes wall seconds
+    #: (= device_wait + host_decode + the commit phase's write section)
     commit_seconds: float = 0.0
+    #: kai-trace phase attribution: contiguous checkpoints on ONE clock
+    #: partition the cycle into snapshot / upload / solve_dispatch /
+    #: device_wait / host_decode / commit, so the phases sum to the
+    #: cycle wall time by construction (see runtime/tracing.py)
+    phase_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class Action(Protocol):
@@ -257,8 +265,14 @@ class Scheduler:
     """
 
     def __init__(self, config: SchedulerConfig | None = None,
-                 usage_lister=None, status_updater=None):
+                 usage_lister=None, status_updater=None, tracer=None):
         self.config = config or SchedulerConfig()
+        #: kai-trace flight recorder: every cycle records its
+        #: phase-attributed span tree into the tracer's bounded ring
+        #: (served as Chrome-trace JSON by GET /debug/trace)
+        self.tracer = tracer or CycleTracer()
+        #: per-gang decision event log (GET /debug/events?gang=)
+        self.decisions = DecisionLog()
         if self.config.shard is not None:
             self.config = dataclasses.replace(
                 self.config,
@@ -320,101 +334,167 @@ class Scheduler:
         Under leader election, a non-leader instance performs NO work
         and commits nothing (the reference's followers block inside
         ``leaderelection`` until elected)."""
-        from . import metrics
         if self._elector is not None and not self._elector.is_leader(
                 cluster.now):
             return CycleResult()
         t0 = time.perf_counter()
-        queue_usage = None
-        if self.usage_lister is not None:
-            self.usage_lister.maybe_fetch(cluster.now)
-            queue_usage = self.usage_lister.queue_usage(cluster.now)
-        # NOTE on concurrent status writes: the cycle NEVER blocks on the
-        # async status pool (a slow store must not stall scheduling —
-        # test-pinned), so a snapshot can race an in-flight apply.  Each
-        # attribute store is GIL-atomic, applies are serialized under the
-        # updater's apply_lock, and the apply closures order their writes
-        # so every observable prefix is a conservative state (see
-        # _record_fit_status) — a racing snapshot at worst treats a gang
-        # as schedulable for one extra cycle, never spuriously
-        # unschedulable with a stale reason.
-        if self.config.incremental and self.config.shard is None:
-            # journaled incremental refresh: the snapshotter patches the
-            # previous cycle's snapshot from the cluster's mutation
-            # journal (dirty rows only, changed leaves only to device),
-            # falling back to build_snapshot whenever the patch cannot
-            # be proven identical — see state/incremental.py
-            if (self._snapshotter_cluster is None
-                    or self._snapshotter_cluster() is not cluster):
-                from ..state.incremental import IncrementalSnapshotter
-                self._snapshotter = IncrementalSnapshotter(
-                    verify=self.config.verify_incremental,
-                    dirty_threshold=self.config
-                    .incremental_dirty_threshold)
-                self._snapshotter_cluster = weakref.ref(cluster)
-            state, index = self._snapshotter.refresh(
-                cluster, now=cluster.now, queue_usage=queue_usage)
-            session = Session.from_state(state, index,
-                                         config=self.config.session)
-        else:
-            session = Session.open(
-                *self._shard_filter(*cluster.snapshot_lists()),
-                config=self.config.session,
-                now=cluster.now, queue_usage=queue_usage,
-                resource_claims=cluster.resource_claims,
-                device_classes=cluster.device_classes,
-                volume_claims=cluster.volume_claims,
-                storage_classes=cluster.storage_classes)
-        open_s = time.perf_counter() - t0
+        with self.tracer.cycle() as trace:
+            result = self._run_traced(cluster, trace, t0)
+            trace.root.attrs.update(
+                binds=len(result.bind_requests),
+                evictions=len(result.evictions))
+        return result
+
+    def _run_traced(self, cluster: Cluster, trace, t0: float) -> CycleResult:
+        """The cycle body, recorded under an open kai-trace cycle.
+        Phase timings are CONTIGUOUS checkpoints on one clock, so
+        ``phase_seconds`` partitions the wall time exactly (the
+        acceptance property BENCH phase attribution relies on)."""
+        from . import metrics
+        with self.tracer.span("snapshot") as snap_sp:
+            queue_usage = None
+            if self.usage_lister is not None:
+                self.usage_lister.maybe_fetch(cluster.now)
+                queue_usage = self.usage_lister.queue_usage(cluster.now)
+            # NOTE on concurrent status writes: the cycle NEVER blocks on
+            # the async status pool (a slow store must not stall
+            # scheduling — test-pinned), so a snapshot can race an
+            # in-flight apply.  Each attribute store is GIL-atomic,
+            # applies are serialized under the updater's apply_lock, and
+            # the apply closures order their writes so every observable
+            # prefix is a conservative state (see _record_fit_status) —
+            # a racing snapshot at worst treats a gang as schedulable for
+            # one extra cycle, never spuriously unschedulable with a
+            # stale reason.
+            upload_s = 0.0
+            if self.config.incremental and self.config.shard is None:
+                # journaled incremental refresh: the snapshotter patches
+                # the previous cycle's snapshot from the cluster's
+                # mutation journal (dirty rows only, changed leaves only
+                # to device), falling back to build_snapshot whenever the
+                # patch cannot be proven identical — see
+                # state/incremental.py
+                if (self._snapshotter_cluster is None
+                        or self._snapshotter_cluster() is not cluster):
+                    from ..state.incremental import IncrementalSnapshotter
+                    self._snapshotter = IncrementalSnapshotter(
+                        verify=self.config.verify_incremental,
+                        dirty_threshold=self.config
+                        .incremental_dirty_threshold,
+                        tracer=self.tracer)
+                    self._snapshotter_cluster = weakref.ref(cluster)
+                state, index = self._snapshotter.refresh(
+                    cluster, now=cluster.now, queue_usage=queue_usage)
+                session = Session.from_state(state, index,
+                                             config=self.config.session)
+                # journal-delta stats of THIS refresh onto the span:
+                # mode (patched/full), fallback reason, dirty rows,
+                # changed leaves and bytes actually uploaded
+                snap_sp.attrs.update(self._snapshotter.stats.last)
+                upload_s = float(
+                    self._snapshotter.stats.last.get("ship_seconds", 0.0))
+            else:
+                session = Session.open(
+                    *self._shard_filter(*cluster.snapshot_lists()),
+                    config=self.config.session,
+                    now=cluster.now, queue_usage=queue_usage,
+                    resource_claims=cluster.resource_claims,
+                    device_classes=cluster.device_classes,
+                    volume_claims=cluster.volume_claims,
+                    storage_classes=cluster.storage_classes)
+                snap_sp.attrs["mode"] = "open"
+        t_open = time.perf_counter()
+        open_s = t_open - t0
         metrics.open_session_latency.observe(value=open_s)
         result = CycleResult(tensors=init_result(session.state))
         result.open_seconds = open_s
-        if all(name in _PURE_ACTIONS
-               and _ACTION_REGISTRY.get(name) is _BUILTIN_BUILDERS.get(name)
-               for name in self.config.actions):
-            # fast path: the whole action pipeline as one compiled program
-            cfg = session.config
-            ta = time.perf_counter()
-            result.tensors = _fused_pipeline(
-                session.state, session.state.queues.fair_share,
-                actions=tuple(self.config.actions),
-                num_levels=cfg.num_levels, acfg=cfg.allocate,
-                vcfg=cfg.victims, grace_s=cfg.stale_grace_s)
-            result.action_seconds["pipeline"] = time.perf_counter() - ta
-            metrics.action_latency.observe(
-                "pipeline", value=result.action_seconds["pipeline"])
-        else:
-            for name, action in self._actions:
+        with self.tracer.span("solve_dispatch"):
+            if all(name in _PURE_ACTIONS
+                   and _ACTION_REGISTRY.get(name)
+                   is _BUILTIN_BUILDERS.get(name)
+                   for name in self.config.actions):
+                # fast path: the whole action pipeline as one compiled
+                # program
+                cfg = session.config
                 ta = time.perf_counter()
-                action(session, result)
-                result.action_seconds[name] = time.perf_counter() - ta
+                with self.tracer.span("action:pipeline"):
+                    result.tensors = _fused_pipeline(
+                        session.state, session.state.queues.fair_share,
+                        actions=tuple(self.config.actions),
+                        num_levels=cfg.num_levels, acfg=cfg.allocate,
+                        vcfg=cfg.victims, grace_s=cfg.stale_grace_s)
+                result.action_seconds["pipeline"] = \
+                    time.perf_counter() - ta
                 metrics.action_latency.observe(
-                    name, value=result.action_seconds[name])
+                    "pipeline", value=result.action_seconds["pipeline"])
+            else:
+                for name, action in self._actions:
+                    ta = time.perf_counter()
+                    with self.tracer.span(f"action:{name}"):
+                        action(session, result)
+                    result.action_seconds[name] = time.perf_counter() - ta
+                    metrics.action_latency.observe(
+                        name, value=result.action_seconds[name])
+        t_solve = time.perf_counter()
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
-        # ONE batched device→host transfer feeds every host-side step.
-        tc = time.perf_counter()
-        host = session.gather_host(result.tensors)
-        result.bind_requests = session.bind_requests_from(
-            result.tensors, host=host)
-        result.evictions = session.evictions_from(
-            result.tensors.victim, result.tensors.victim_move, host=host)
-        for br in result.bind_requests:
-            cluster.create_bind_request(br)
-        for ev in result.evictions:
-            # consolidation victims restart and get a pipelined rebind on
-            # their verified target node — evicted, not lost
-            # (ref consolidation.go allPodsReallocated + stmt pipelining)
-            cluster.evict_pod(ev.pod_name, restart=ev.move_to is not None)
-            if ev.move_to is not None:
-                pod = cluster.pods.get(ev.pod_name)
-                if pod is not None:
-                    rebind = session.move_bind_request(pod, ev.move_to)
-                    result.move_bind_requests.append(rebind)
-                    cluster.create_bind_request(rebind)
-        result.commit_seconds = time.perf_counter() - tc
-        self._record_fit_status(cluster, session, result, host)
-        self._record_metrics(session, result, host)
+        # ONE batched device→host transfer feeds every host-side step —
+        # the device_wait span brackets it as the cycle's explicit
+        # device-sync marker (dispatches above were async, so this wait
+        # is link + device time, not host work).
+        with self.tracer.span("device_wait", device_sync=True):
+            host = session.gather_host(result.tensors)
+        t_gather = time.perf_counter()
+        with self.tracer.span("host_decode"):
+            result.bind_requests = session.bind_requests_from(
+                result.tensors, host=host)
+            result.evictions = session.evictions_from(
+                result.tensors.victim, result.tensors.victim_move,
+                host=host)
+        t_decode = time.perf_counter()
+        with self.tracer.span("commit"):
+            with self.tracer.span("writes"):
+                for br in result.bind_requests:
+                    cluster.create_bind_request(br)
+                for ev in result.evictions:
+                    # consolidation victims restart and get a pipelined
+                    # rebind on their verified target node — evicted, not
+                    # lost (ref consolidation.go allPodsReallocated +
+                    # stmt pipelining)
+                    cluster.evict_pod(ev.pod_name,
+                                      restart=ev.move_to is not None)
+                    if ev.move_to is not None:
+                        pod = cluster.pods.get(ev.pod_name)
+                        if pod is not None:
+                            rebind = session.move_bind_request(
+                                pod, ev.move_to)
+                            result.move_bind_requests.append(rebind)
+                            cluster.create_bind_request(rebind)
+            result.commit_seconds = time.perf_counter() - t_solve
+            with self.tracer.span("status_updates") as st_sp:
+                self._record_fit_status(cluster, session, result, host)
+                if self.status_updater is not None:
+                    st_sp.attrs.update(
+                        pending=self.status_updater.pending,
+                        applied=self.status_updater.applied,
+                        errors=self.status_updater.errors)
+            events, dropped, counts = session.decision_events(
+                result.tensors, host=host, evictions=result.evictions,
+                limit=self.decisions.max_events_per_cycle)
+            self.decisions.record_cycle(trace.cycle_id, events,
+                                        dropped=dropped, counts=counts)
+            self._record_metrics(session, result, host)
+        t_end = time.perf_counter()
+        result.phase_seconds = {
+            "snapshot": max(0.0, open_s - upload_s),
+            "upload": upload_s,
+            "solve_dispatch": t_solve - t_open,
+            "device_wait": t_gather - t_solve,
+            "host_decode": t_decode - t_gather,
+            "commit": t_end - t_decode,
+        }
+        for phase, secs in result.phase_seconds.items():
+            metrics.cycle_phase_seconds.observe(phase, value=secs)
         result.session_seconds = time.perf_counter() - t0
         metrics.e2e_latency.observe(value=result.session_seconds)
         return result

@@ -1,7 +1,7 @@
 """Scheduler sidecar server — the PluginServer + the snapshot-in /
 placements-out wire boundary.
 
-Three reference surfaces collapse into one stdlib HTTP server:
+Reference surfaces collapse into one stdlib HTTP server:
 
 - ``GET /job-order``  — the reflectjoborder plugin
   (``plugins/reflectjoborder``): the computed job order of the last (or
@@ -14,6 +14,13 @@ Three reference surfaces collapse into one stdlib HTTP server:
   another language can mount the TPU solver behind its own registries.
 - ``GET /metrics``    — Prometheus text exposition
   (``pkg/scheduler/metrics``).
+- ``GET /debug/trace``  — the kai-trace flight recorder
+  (``runtime/tracing.py``): the last N cycles' phase-attributed span
+  trees as Chrome-trace JSON (``?cycles=`` bounds the window).
+- ``GET /debug/events`` — per-gang decision events
+  (``runtime/events.py``): every considered gang's cycle outcome
+  (allocated / fit-failure / quota-gate / preempted-for);
+  ``?gang=<name>`` filters to one pod group.
 
 The server is deliberately dependency-free (http.server); a production
 deployment would front it with gRPC — the payloads are already the
@@ -26,6 +33,7 @@ import cProfile
 import json
 import pstats
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -64,11 +72,18 @@ def profile_cycle(cluster: Cluster, scheduler: Scheduler,
                   top: int = 25) -> dict:
     """One scheduling cycle under cProfile — the pprof
     ``/debug/pprof/profile`` analogue (ref ``cmd/scheduler/profiling``):
-    returns the hottest host-side functions plus the cycle's phase
-    timings (device time shows up as the blocking transfer)."""
-    # profile against a private copy: a profiling GET must never write
-    # bind requests or evictions into the server's stored cluster
+    returns the hottest host-side functions plus the cycle's kai-trace
+    phase breakdown (``CycleResult.phase_seconds`` — the tracer's
+    attribution, not ad-hoc timers; device time is the ``device_wait``
+    phase)."""
+    # profile against private copies: a profiling GET must never write
+    # bind requests or evictions into the server's stored cluster, and
+    # the synthetic cProfile-inflated cycle must not pollute the LIVE
+    # scheduler's trace ring / decision log or repoint its warm
+    # incremental snapshotter at the throwaway deepcopy
     cluster = copy.deepcopy(cluster)
+    scheduler = Scheduler(scheduler.config,
+                          usage_lister=scheduler.usage_lister)
     prof = cProfile.Profile()
     prof.enable()
     result = scheduler.run_once(cluster)
@@ -83,8 +98,7 @@ def profile_cycle(cluster: Cluster, scheduler: Scheduler,
                      "cumulative_s": round(ct, 6)})
     rows.sort(key=lambda r: -r["cumulative_s"])
     return {
-        "open_seconds": result.open_seconds,
-        "commit_seconds": result.commit_seconds,
+        "phases": dict(result.phase_seconds),
         "total_seconds": result.session_seconds,
         "action_seconds": result.action_seconds,
         "hottest": rows[:top],
@@ -257,6 +271,36 @@ class SchedulerServer:
                     # in place), so this read needs no lock
                     stats = outer._cycle_stats
                     self._send({"ok": True, "last_cycle": stats})
+                elif self.path.startswith("/debug/trace"):
+                    # kai-trace flight recorder: the retained cycle ring
+                    # as Chrome-trace JSON.  Only the scheduler HANDLE
+                    # is read under the state lock; the export itself
+                    # runs outside it — the tracer rings only COMPLETED,
+                    # immutable traces under its own lock, so the export
+                    # can never tear and must not stall cycle POSTs.
+                    params = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        cycles = (int(params["cycles"][0])
+                                  if "cycles" in params else None)
+                    except ValueError:
+                        self.send_error(400, "cycles must be an integer")
+                        return
+                    with outer._state_lock:
+                        tracer = outer.scheduler.tracer
+                    self._send(tracer.export_chrome(cycles=cycles))
+                elif self.path.startswith("/debug/events"):
+                    # per-gang decision events: ?gang=<name> filters.
+                    # Same discipline as /debug/trace: handle under the
+                    # lock, the (internally locked) log reads outside
+                    params = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    gang = params.get("gang", [None])[0]
+                    with outer._state_lock:
+                        log = outer.scheduler.decisions
+                    self._send({"gang": gang,
+                                "events": log.events(gang=gang),
+                                "summary": log.summary()})
                 elif self.path.startswith("/debug/pprof/continuous"):
                     # the continuous-profiling (Pyroscope) analogue:
                     # retained folded-stack windows (profiler state is
@@ -390,6 +434,8 @@ class SchedulerServer:
                 open_seconds=result.open_seconds,
                 commit_seconds=result.commit_seconds,
                 total_seconds=result.session_seconds,
+                phase_seconds=dict(result.phase_seconds),
+                decisions=self.scheduler.decisions.summary(),
                 bind_requests=len(result.bind_requests),
                 evictions=len(result.evictions))
         self._cycle_stats = stats

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..apis import types as apis
 from ..ops import drf
+from ..runtime import events as gang_events
 from ..ops.allocate import AllocateConfig, AllocationResult
 from ..ops.victims import VictimConfig
 from ..state.cluster_state import (ClusterState, SnapshotIndex,
@@ -430,6 +431,113 @@ class Session:
             out[self.index.gang_names[gi]] = FIT_REASONS.get(
                 int(reasons[gi]), f"code {int(reasons[gi])}")
         return out
+
+    #: per-cycle caps on decision-event CONSTRUCTION (the commit path
+    #: must not spend milliseconds building event objects; exact
+    #: outcome COUNTS are always recorded regardless).  Failures keep
+    #: the larger budget — they are the diagnostic payload — and
+    #: ``allocated`` success events the smallest.
+    MAX_FAILURE_EVENTS = 1024
+    MAX_ALLOCATED_EVENTS = 512
+
+    def decision_events(self, result: AllocationResult,
+                        host: dict | None = None, evictions=None,
+                        limit: int = 4096):
+        """Per-gang outcome events for the cycle — the "why is my job
+        not running" surface (``runtime/events.py``).  Returns
+        ``(events, dropped, counts)``: a bounded list of
+        :class:`~..runtime.events.GangDecision`, how many candidate
+        events the bounds cut, and the EXACT per-outcome counts
+        (computed vectorized, unaffected by truncation).
+
+        Ordering is by diagnostic value: fit failures first (the answer
+        an operator is actually looking for), then preemption victims,
+        then allocations (bounded hardest — see
+        ``MAX_ALLOCATED_EVENTS``).
+        """
+        if host is None:
+            host = self.gather_host(result)
+        names = self.index.gang_names
+        ng = len(names)
+        allocated = host["allocated"][:ng]
+        reasons = host["fit_reason"][:ng]
+        pipelined = host["pipelined"][:ng]
+        queues_of = np.asarray(self.state.gangs.queue)[:ng]
+        qnames = self.index.queue_names
+        nq = len(qnames)
+
+        def queue_name(gi: int) -> str:
+            qi = int(queues_of[gi])
+            return qnames[qi] if 0 <= qi < nq else ""
+
+        out: list = []
+        dropped = 0
+        # beneficiaries of freed capacity: gangs whose placements
+        # pipelined onto releasing/victim resources this cycle
+        pipe_g = np.nonzero(pipelined.any(axis=1))[0]
+        beneficiaries = ", ".join(names[int(g)] for g in pipe_g[:3])
+        if len(pipe_g) > 3:
+            beneficiaries += f", +{len(pipe_g) - 3} more"
+        # exact outcome counts, vectorized — truncation below never
+        # skews the /healthz summary
+        failed = (reasons != 0) & ~allocated
+        counts = {
+            gang_events.OUTCOME_ALLOCATED: int(allocated.sum()),
+            gang_events.OUTCOME_QUOTA_GATE: int(
+                (failed & (reasons == 3)).sum()),
+            gang_events.OUTCOME_FIT_FAILURE: int(
+                (failed & (reasons != 3)).sum()),
+            gang_events.OUTCOME_PREEMPTED_FOR: len(
+                {ev.group for ev in evictions if ev.group}
+                if evictions else ()),
+        }
+        counts = {k: v for k, v in counts.items() if v}
+        # 1. fit failures (reason code -> outcome + FIT_REASONS detail).
+        # Every section SLICES to its remaining room and counts the
+        # overflow arithmetically — the loops never iterate past the
+        # bound (this runs on the commit path of every cycle)
+        fail_g = np.nonzero(failed)[0]
+        take = fail_g[:min(limit, self.MAX_FAILURE_EVENTS)].tolist()
+        dropped += len(fail_g) - len(take)
+        for gi in take:
+            code = int(reasons[gi])
+            outcome = (gang_events.OUTCOME_QUOTA_GATE if code == 3
+                       else gang_events.OUTCOME_FIT_FAILURE)
+            out.append(gang_events.GangDecision(
+                gang=names[gi], queue=queue_name(gi), outcome=outcome,
+                detail=FIT_REASONS.get(code, f"code {code}")))
+        # 2. preemption/reclaim/consolidation victims, one event per
+        # victim GANG (bounded like everything else)
+        if evictions:
+            seen: dict[str, bool] = {}
+            for ev in evictions:
+                if ev.group and ev.group not in seen:
+                    seen[ev.group] = ev.move_to is not None
+            groups = list(seen.items())
+            room = max(0, limit - len(out))
+            dropped += max(0, len(groups) - room)
+            for group, moved in groups[:room]:
+                detail = ("consolidation move (pipelined rebind)" if moved
+                          else (f"freed capacity for: {beneficiaries}"
+                                if beneficiaries else "over fair share"))
+                out.append(gang_events.GangDecision(
+                    gang=group, queue="",
+                    outcome=gang_events.OUTCOME_PREEMPTED_FOR,
+                    detail=detail))
+        # 3. allocations (bounded hardest; the exact counts above keep
+        # the summary honest about the rest)
+        alloc_g = np.nonzero(allocated)[0]
+        room = max(0, min(limit - len(out), self.MAX_ALLOCATED_EVENTS))
+        take = alloc_g[:room].tolist()
+        dropped += len(alloc_g) - len(take)
+        pipe_set = set(pipe_g.tolist())
+        for gi in take:
+            out.append(gang_events.GangDecision(
+                gang=names[gi], queue=queue_name(gi),
+                outcome=gang_events.OUTCOME_ALLOCATED,
+                detail=("pipelined onto releasing capacity"
+                        if gi in pipe_set else "")))
+        return out, dropped, counts
 
     def move_bind_request(self, pod: apis.Pod,
                           target_node: str) -> apis.BindRequest:

@@ -1,0 +1,114 @@
+"""Per-gang decision events — the "why is my job not running" surface.
+
+The reference answers that question with pod events written by the
+status updater (``UnschedulableOnNodePool`` conditions and
+per-pod-group eviction/preemption events).  Here every considered gang
+records its cycle outcome into a bounded per-cycle buffer:
+
+* ``allocated``      — the gang's tasks bound (or pipelined) this cycle;
+* ``fit-failure``    — no node satisfied the gang (reason text from
+  ``Session.FIT_REASONS``);
+* ``quota-gate``     — the placement attempt failed on capacity or
+  queue gates (fit-reason code 3);
+* ``preempted-for``  — the gang's running pods were evicted to free
+  capacity for pending work (detail names the beneficiaries when the
+  commit pipelined onto the freed capacity).
+
+The log retains the last N cycles and is served by
+``GET /debug/events?gang=<name>`` on the SchedulerServer; its last-cycle
+summary rides the ``/healthz`` cycle-stats document.
+
+Concurrency: events for one cycle are built on the cycle thread and
+enter the ring in one append under ``_lock``; ringed entries are
+immutable tuples (discipline declared in ``analysis/guarded_by.json``,
+checked by kai-race) — a concurrent scrape can never observe a
+half-recorded cycle.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+__all__ = [
+    "GangDecision", "DecisionLog", "OUTCOME_ALLOCATED",
+    "OUTCOME_FIT_FAILURE", "OUTCOME_QUOTA_GATE", "OUTCOME_PREEMPTED_FOR",
+]
+
+OUTCOME_ALLOCATED = "allocated"
+OUTCOME_FIT_FAILURE = "fit-failure"
+OUTCOME_QUOTA_GATE = "quota-gate"
+OUTCOME_PREEMPTED_FOR = "preempted-for"
+
+
+@dataclasses.dataclass(frozen=True)
+class GangDecision:
+    """One gang's outcome in one cycle."""
+
+    gang: str
+    queue: str
+    outcome: str
+    detail: str = ""
+
+    def to_doc(self, cycle: int) -> dict:
+        return {"cycle": cycle, "gang": self.gang, "queue": self.queue,
+                "outcome": self.outcome, "detail": self.detail}
+
+
+class DecisionLog:
+    """Bounded ring of per-cycle gang decision events."""
+
+    def __init__(self, retain_cycles: int = 8,
+                 max_events_per_cycle: int = 4096):
+        self._lock = threading.Lock()
+        #: (cycle id, immutable event tuple, dropped count, exact
+        #: outcome counts), oldest first
+        self._cycles: list[tuple[int, tuple, int, dict]] = []  # kai-race: guarded-by=_lock
+        self._retain = max(1, int(retain_cycles))
+        #: per-cycle event bound — a 50k-gang snapshot must not turn the
+        #: debug surface into a second commit path
+        self.max_events_per_cycle = max(1, int(max_events_per_cycle))
+
+    def record_cycle(self, cycle_id: int, events: list,
+                     dropped: int = 0, counts: dict | None = None) -> None:
+        """Ring one cycle's events atomically.  ``dropped`` counts
+        candidates the producer already truncated; anything beyond the
+        per-cycle bound here adds to it.  ``counts`` carries the
+        producer's EXACT per-outcome totals (cheap to compute
+        vectorized) so the summary stays honest when the event list is
+        truncated; omitted, the summary counts the retained events."""
+        cap = self.max_events_per_cycle
+        over = max(0, len(events) - cap)
+        if counts is None:
+            counts = {}
+            for e in events:
+                counts[e.outcome] = counts.get(e.outcome, 0) + 1
+        entry = (int(cycle_id), tuple(events[:cap]),
+                 int(dropped) + over, dict(counts))
+        with self._lock:
+            self._cycles.append(entry)
+            del self._cycles[:-self._retain]
+
+    def events(self, gang: str | None = None, limit: int = 500) -> list[dict]:
+        """Decision docs, newest cycle first, optionally filtered to one
+        gang — the ``GET /debug/events?gang=`` payload."""
+        with self._lock:
+            cycles = list(self._cycles)
+        out: list[dict] = []
+        for cid, evs, _dropped, _counts in reversed(cycles):
+            for e in evs:
+                if gang is None or e.gang == gang:
+                    out.append(e.to_doc(cid))
+                    if len(out) >= limit:
+                        return out
+        return out
+
+    def summary(self) -> dict:
+        """Last cycle's EXACT outcome counts (``outcomes``) plus how
+        many events the ring retains (``events``) — the ``/healthz``
+        slice."""
+        with self._lock:
+            if not self._cycles:
+                return {}
+            cid, evs, dropped, counts = self._cycles[-1]
+        return {"cycle": cid, "outcomes": dict(counts),
+                "events": len(evs), "dropped": dropped}

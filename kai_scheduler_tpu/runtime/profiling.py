@@ -91,9 +91,30 @@ class ContinuousProfiler:
             # the caller thread while the sampler's own push is in flight
             with self._lock:
                 self.pushed += 1
+            self._count_push(ok=True)
         except Exception:  # noqa: BLE001 — profiling must never bite
             with self._lock:
                 self.push_errors += 1
+            self._count_push(ok=False)
+
+    @staticmethod
+    def _count_push(ok: bool) -> None:
+        """Mirror the push counters into the metrics registry
+        (``kai_profiler_pushed_windows_total`` /
+        ``kai_profiler_push_errors_total``) so ``/metrics`` sees them —
+        the bare instance attributes stay for direct inspection."""
+        try:
+            # package-relative cycle-breaker: framework.server lazily
+            # imports this module, and importing the framework package
+            # here at module scope would drag jax into every profiler
+            # import
+            from ..framework import metrics
+            if ok:
+                metrics.profiler_pushed_windows.inc()
+            else:
+                metrics.profiler_push_errors.inc()
+        except Exception:  # noqa: BLE001 — a metrics mirror must never
+            pass  # kill the sampler thread (attribute counters stand)
 
     def _run(self) -> None:
         period = 1.0 / self.sample_hz

@@ -75,6 +75,11 @@ class AsyncStatusUpdater:
             return self._applied
 
     @property
+    def errors(self) -> int:
+        with self._lock:
+            return self._errors
+
+    @property
     def pending(self) -> int:
         with self._lock:
             return len(self._latest)

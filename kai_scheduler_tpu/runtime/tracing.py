@@ -1,0 +1,249 @@
+"""kai-trace — the cycle flight recorder.
+
+The reference treats observability as a first-class layer (per-action /
+per-plugin latency metrics, pod events explaining unschedulability,
+continuous profiles).  This module is the span half of that story for
+the TPU rebuild: a thread-safe recorder of *phase-attributed spans*
+over the scheduling cycle, kept in a bounded ring of recent cycle
+traces and exportable as Chrome-trace ("Trace Event Format") JSON —
+loadable in ``chrome://tracing`` / Perfetto — via ``GET /debug/trace``
+on the :class:`~..framework.server.SchedulerServer`.
+
+Why spans and not three wall timers: kernels dispatch *async*, so a
+naive per-step timer smears device execution, transfer wait, and host
+decode into whichever step first blocks (historically all of it landed
+in ``commit_seconds``).  The cycle driver therefore records explicit
+**device-sync markers** (``device_sync=True`` spans) around the first
+blocking transfer, splitting the old commit wall into
+``device_wait`` / ``host_decode`` / ``commit`` — the attribution
+ROADMAP item 1 (breaking the ~109 ms host↔device link floor) needs
+before any of that floor can be attacked.
+
+Concurrency model: span recording is **thread-local** — each thread
+owns the trace of the cycle it is running (an open trace is reachable
+only through ``threading.local``, so no other thread can observe a
+half-built span tree).  A trace enters the shared ring only once the
+cycle closes, and ring entries are never mutated afterwards; ring
+append/read is serialized under ``_lock`` (discipline declared in
+``analysis/guarded_by.json``, checked by kai-race).  Exports therefore
+can never serve a torn document.
+
+Tracer calls are HOST-side by construction: kai-lint rule ``KAI061``
+forbids them inside the jit-traced region (a span body executes at
+trace time, not at kernel run time — it would record compilation, not
+execution, and its timestamps would be garbage).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+__all__ = ["Span", "CycleTrace", "CycleTracer"]
+
+#: attr value types exported verbatim; anything else is stringified
+_JSONABLE = (str, int, float, bool, type(None))
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed region of a cycle.
+
+    ``start``/``end`` are ``time.perf_counter`` seconds (monotonic);
+    ``children`` are strictly nested inside ``[start, end]`` by
+    construction (context-manager discipline).
+    """
+
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict = dataclasses.field(default_factory=dict)
+    children: list = dataclasses.field(default_factory=list)
+    #: an explicit device-sync marker: this span brackets a blocking
+    #: device→host (or host→device) boundary, so its duration is link +
+    #: device time, not host work
+    device_sync: bool = False
+
+    @property
+    def seconds(self) -> float:
+        return max(0.0, self.end - self.start)
+
+
+@dataclasses.dataclass
+class CycleTrace:
+    """One completed cycle's span tree — immutable once ringed."""
+
+    cycle_id: int
+    #: unix epoch at cycle start — anchors perf_counter offsets so
+    #: multiple cycles export onto one consistent timeline
+    wall_start: float
+    #: the root "cycle" span; the phase spans are its children
+    root: Span
+
+    def phase_seconds(self) -> dict[str, float]:
+        """Top-level (phase) span durations by name.
+
+        Direct children named ``upload`` are promoted to their own
+        phase and subtracted from their parent — matching the cycle
+        driver's ``CycleResult.phase_seconds`` convention, where the
+        snapshotter's transfer-dispatch section is carved out of the
+        ``snapshot`` phase.  Without the promotion the trace's
+        ``snapshot`` number would disagree with the metric/healthz/
+        bench surfaces by exactly the upload duration.
+        """
+        out: dict[str, float] = {}
+        for sp in self.root.children:
+            secs = sp.seconds
+            up = sum(c.seconds for c in sp.children
+                     if c.name == "upload")
+            if up:
+                out["upload"] = out.get("upload", 0.0) + up
+                secs = max(0.0, secs - up)
+            out[sp.name] = out.get(sp.name, 0.0) + secs
+        return out
+
+
+def _clean_attrs(attrs: dict, extra: dict | None = None) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        out[str(k)] = v if isinstance(v, _JSONABLE) else str(v)
+    if extra:
+        out.update(extra)
+    return out
+
+
+def _emit_span(events: list, sp: Span, origin_us: float, root_start: float,
+               tid: int) -> None:
+    """Append one span (and, recursively, its children) as a Chrome
+    "X" (complete) event.  ``origin_us`` maps this trace's
+    ``perf_counter`` timeline onto the shared wall-anchored export
+    timeline."""
+    extra = {"device_sync": True} if sp.device_sync else None
+    events.append({
+        "name": sp.name, "ph": "X", "pid": 0, "tid": tid,
+        "ts": round(origin_us + (sp.start - root_start) * 1e6, 3),
+        "dur": round(sp.seconds * 1e6, 3),
+        "args": _clean_attrs(sp.attrs, extra),
+    })
+    for child in sp.children:
+        _emit_span(events, child, origin_us, root_start, tid)
+
+
+class CycleTracer:
+    """Thread-safe cycle span recorder with a bounded trace ring.
+
+    Recording API (all host-side; never call from jit-traced code —
+    KAI061)::
+
+        with tracer.cycle() as trace:            # one scheduling cycle
+            with tracer.span("snapshot") as sp:  # a phase
+                ...
+                sp.attrs["mode"] = "patched"
+            with tracer.span("device_wait", device_sync=True):
+                host = gather()                  # the blocking transfer
+        trace.phase_seconds()                    # {"snapshot": ..., ...}
+
+    ``span`` outside an open cycle records nothing (it yields a
+    detached dummy span), so instrumented helpers — e.g. the
+    incremental snapshotter's upload section — stay callable from
+    benches and CLIs that never open a cycle.
+    """
+
+    def __init__(self, retain_cycles: int = 16):
+        self._lock = threading.Lock()
+        self._ring: list[CycleTrace] = []  # kai-race: guarded-by=_lock
+        self._cycle_seq = 0  # kai-race: guarded-by=_lock
+        #: ring bound — immutable after construction
+        self._retain = max(1, int(retain_cycles))
+        #: per-thread open-span stack (an open trace is visible only to
+        #: the thread recording it; read-only binding after init)
+        self._local = threading.local()
+
+    # -- recording --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def cycle(self, **attrs):
+        """Record one cycle; the trace enters the ring when the block
+        exits (never before, so readers cannot observe a live tree)."""
+        with self._lock:
+            cid = self._cycle_seq
+            self._cycle_seq += 1
+        root = Span(name="cycle", start=time.perf_counter(),
+                    attrs=_clean_attrs(attrs))
+        trace = CycleTrace(cycle_id=cid, wall_start=time.time(), root=root)
+        prev = getattr(self._local, "stack", None)
+        self._local.stack = [root]
+        try:
+            yield trace
+        finally:
+            root.end = time.perf_counter()
+            self._local.stack = prev
+            with self._lock:
+                self._ring.append(trace)
+                del self._ring[:-self._retain]
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, device_sync: bool = False, **attrs):
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            # no open cycle on this thread: detached spans record
+            # nothing (the dummy keeps `sp.attrs[...] = ...` callers
+            # working unconditionally)
+            yield Span(name=name, start=0.0, attrs=_clean_attrs(attrs),
+                       device_sync=device_sync)
+            return
+        sp = Span(name=name, start=time.perf_counter(),
+                  attrs=_clean_attrs(attrs), device_sync=device_sync)
+        stack[-1].children.append(sp)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.perf_counter()
+            stack.pop()
+
+    def add_span(self, name: str, start: float, end: float,
+                 *, device_sync: bool = False, **attrs) -> None:
+        """Attach an already-timed span (``perf_counter`` seconds) as a
+        child of the currently open span — for sections timed inside
+        helpers that cannot hold a context manager open (e.g. the
+        snapshotter's upload loop).  No-op without an open cycle."""
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return
+        stack[-1].children.append(Span(
+            name=name, start=start, end=end, attrs=_clean_attrs(attrs),
+            device_sync=device_sync))
+
+    # -- reading ----------------------------------------------------------
+
+    def last(self, n: int = 1) -> list[CycleTrace]:
+        """The most recent ``n`` completed cycle traces, oldest first."""
+        with self._lock:
+            return list(self._ring[-max(1, n):])
+
+    def export_chrome(self, cycles: int | None = None) -> dict:
+        """The retained ring (or the last ``cycles``) as a Chrome-trace
+        JSON document: ``{"traceEvents": [...]}`` with "X" complete
+        events, one ``tid`` lane per cycle so concurrent recorders can
+        never interleave into a partially-overlapping (non-nested)
+        lane."""
+        with self._lock:
+            traces = list(self._ring if cycles is None
+                          else self._ring[-max(1, cycles):])
+        events: list[dict] = [{
+            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": "kai-scheduler"},
+        }]
+        if traces:
+            epoch = min(t.wall_start for t in traces)
+            for t in traces:
+                tid = t.cycle_id
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": 0,
+                    "tid": tid, "args": {"name": f"cycle-{t.cycle_id}"},
+                })
+                _emit_span(events, t.root, (t.wall_start - epoch) * 1e6,
+                           t.root.start, tid)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

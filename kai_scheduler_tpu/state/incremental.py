@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 import weakref
 
 import jax
@@ -287,6 +288,11 @@ class SnapshotterStats:
     fallbacks: dict = dataclasses.field(default_factory=dict)
     leaves_shipped: int = 0
     bytes_shipped: int = 0
+    #: the LAST refresh's journal-delta stats — mode (patched/full),
+    #: fallback reason, dirty rows, changed leaves/bytes uploaded and
+    #: upload seconds; feeds the kai-trace snapshot span's attributes
+    #: and the bench phase attribution (runtime/tracing.py)
+    last: dict = dataclasses.field(default_factory=dict)
 
     def fallback(self, reason: str) -> None:
         key = reason.split(":")[0]
@@ -305,16 +311,27 @@ class IncrementalSnapshotter:
     """
 
     def __init__(self, *, verify: bool = False,
-                 dirty_threshold: float = 0.35):
+                 dirty_threshold: float = 0.35, tracer=None):
         self.verify = verify
         self.dirty_threshold = dirty_threshold
         self.stats = SnapshotterStats()
+        #: optional runtime.tracing.CycleTracer — when the scheduler
+        #: drives the refresh inside an open cycle trace, the patch /
+        #: full-build sections and the device upload record themselves
+        #: as child spans of the cycle's "snapshot" phase.  Tracer calls
+        #: no-op without an open cycle (bench/CLI refreshes stay free).
+        self._tracer = tracer
         self._cluster_ref = None
         self._cursor: JournalCursor | None = None
         self._host = None        # numpy ClusterState (previous cycle)
         self._dev = None         # device ClusterState (previous cycle)
         self._index = None
         self._capacity = SnapshotCapacity()
+
+    def _add_span(self, name: str, start: float, **attrs) -> None:
+        if self._tracer is not None:
+            self._tracer.add_span(name, start, time.perf_counter(),
+                                  **attrs)
 
     # -- public -----------------------------------------------------------
 
@@ -331,16 +348,43 @@ class IncrementalSnapshotter:
              else None)
         reason = self._patch_blockers(cluster, j)
         if reason is None:
+            t_patch = time.perf_counter()
             try:
                 state, index = self._patch(cluster, j, now, queue_usage)
+            except _Fallback as exc:
+                reason = exc.reason
+                self._add_span("snapshot.patch_abandoned", t_patch,
+                               fallback_reason=reason)
+            else:
                 self.stats.patched += 1
+                ship = self._last_ship
+                self.stats.last = {
+                    "mode": "patched", "fallback_reason": "",
+                    "dirty_pods": self._last_dirty[0],
+                    "dirty_gangs": self._last_dirty[1],
+                    "leaves_shipped": ship[0], "bytes_shipped": ship[1],
+                    "ship_seconds": ship[2],
+                }
+                self._add_span("snapshot.patch", t_patch,
+                               **self.stats.last)
                 if self.verify:
                     self._verify(cluster, now, queue_usage)
                 return state, index
-            except _Fallback as exc:
-                reason = exc.reason
         self.stats.fallback(reason)
-        return self._full(cluster, now, queue_usage)
+        t_full = time.perf_counter()
+        out = self._full(cluster, now, queue_usage)
+        # the full builder's device transfer happens inside
+        # build_snapshot, so upload is not separable here — the whole
+        # rebuild is one section
+        self.stats.last = {
+            "mode": "full", "fallback_reason": reason,
+            "dirty_pods": 0, "dirty_gangs": 0,
+            "leaves_shipped": 0, "bytes_shipped": 0,
+            "ship_seconds": 0.0,
+        }
+        self._add_span("snapshot.full_build", t_full,
+                       fallback_reason=reason)
+        return out
 
     # -- fallback decisions ----------------------------------------------
 
@@ -912,6 +956,7 @@ class IncrementalSnapshotter:
     def _patch(self, cluster, j, now, queue_usage):
         dirty_rows, dirty_gangs = self._apply_journal(cluster, j)
         self._sweep(cluster, dirty_rows, dirty_gangs)
+        self._last_dirty = (len(dirty_rows), len(dirty_gangs))
         if self._nonplain > 0:
             raise _Fallback("nonplain-pods")
         if self._nonplain_gangs > 0:
@@ -1427,7 +1472,11 @@ class IncrementalSnapshotter:
     def _ship(self, host_new):
         """Transfer only changed leaves; unchanged leaves keep their
         previous device buffers (and their previous host objects, so the
-        next cycle's compares short-circuit on identity)."""
+        next cycle's compares short-circuit on identity).  The transfer
+        section is timed (and span-recorded) as the cycle's "upload"
+        phase."""
+        t_ship = time.perf_counter()
+        leaves = bytes_ = 0
         new_leaves, treedef = jax.tree_util.tree_flatten(host_new)
         old_leaves = jax.tree_util.tree_leaves(self._host)
         dev_leaves = jax.tree_util.tree_leaves(self._dev)
@@ -1440,12 +1489,22 @@ class IncrementalSnapshotter:
                 out_dev.append(dev)
                 out_host.append(old)
             else:
-                self.stats.leaves_shipped += 1
-                self.stats.bytes_shipped += int(new.nbytes)
+                leaves += 1
+                bytes_ += int(new.nbytes)
                 out_dev.append(jax.device_put(new))
                 out_host.append(new)
         self._host = jax.tree_util.tree_unflatten(treedef, out_host)
         self._dev = jax.tree_util.tree_unflatten(treedef, out_dev)
+        ship_s = time.perf_counter() - t_ship
+        self.stats.leaves_shipped += leaves
+        self.stats.bytes_shipped += bytes_
+        self._last_ship = (leaves, bytes_, ship_s)
+        # NOT a device_sync span: jax.device_put is async, so this times
+        # the transfer DISPATCH (flatten + compares + enqueue); the
+        # transfer itself overlaps the solve and completion is absorbed
+        # by the cycle's device_wait sync — exactly the async-attribution
+        # rule the tracer exists to make explicit
+        self._add_span("upload", t_ship, leaves=leaves, bytes=bytes_)
         return self._dev
 
     # -- verification ------------------------------------------------------

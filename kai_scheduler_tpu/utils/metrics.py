@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import bisect
 import dataclasses
+import re
 import threading
 
 
@@ -18,6 +19,8 @@ class _Metric:
     name: str
     help: str
     label_names: tuple[str, ...] = ()
+    #: exposition type — overridden per subclass
+    kind = "untyped"
 
     def _key(self, labels: tuple[str, ...]) -> tuple[str, ...]:
         if len(labels) != len(self.label_names):
@@ -28,6 +31,8 @@ class _Metric:
 
 
 class Counter(_Metric):
+    kind = "counter"
+
     def __init__(self, name, help="", label_names=()):
         super().__init__(name, help, tuple(label_names))
         self._values: dict[tuple[str, ...], float] = {}  # kai-race: guarded-by=_lock
@@ -51,6 +56,8 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
+    kind = "gauge"
+
     def __init__(self, name, help="", label_names=()):
         super().__init__(name, help, tuple(label_names))
         # discipline declared in analysis/guarded_by.json (the cycle's
@@ -78,6 +85,8 @@ _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 
 
 class Histogram(_Metric):
+    kind = "histogram"
+
     def __init__(self, name, help="", label_names=(),
                  buckets=_DEFAULT_BUCKETS):
         super().__init__(name, help, tuple(label_names))
@@ -170,10 +179,73 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def render(self) -> str:
+    def metrics(self) -> list[_Metric]:
+        """Point-in-time copy of the registered metric list (the
+        catalog surface — see ``render_catalog``)."""
         with self._lock:
-            metrics = list(self._metrics)
+            return list(self._metrics)
+
+    def render(self) -> str:
+        metrics = self.metrics()
         lines: list[str] = []
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# catalog exposition — docs/metrics/METRICS.md is GENERATED from the
+# registry through these two functions, and a tier-1 meta-test plus
+# scripts/lint.py assert the committed file and the registry agree
+# exactly (name, type, labels, help), so the catalog can never silently
+# drift.  Pure string code, importable jax-free.
+# ---------------------------------------------------------------------------
+
+_CATALOG_HEADER = """# Metrics catalog
+
+Every metric the scheduler registry exposes through ``/metrics``
+(Prometheus text exposition).  GENERATED — do not edit by hand:
+
+    python -m kai_scheduler_tpu.framework.metrics > docs/metrics/METRICS.md
+
+``tests/test_metrics_catalog.py`` (tier-1) and ``scripts/lint.py``
+both fail when this file and the registry disagree.
+
+| metric | type | labels | help |
+|---|---|---|---|
+"""
+
+
+def render_catalog(rows: list[dict]) -> str:
+    """``[{name, type, labels, help}]`` -> the METRICS.md document."""
+    lines = [_CATALOG_HEADER.rstrip("\n")]
+    for r in sorted(rows, key=lambda r: r["name"]):
+        labels = ", ".join(f"`{l}`" for l in r["labels"]) or "—"
+        # escape cell delimiters: a '|' in help text would split the
+        # row into >4 cells and parse_catalog would drop it — turning
+        # the drift gate into a permanent, unfixable failure
+        help_text = " ".join(str(r["help"]).split()).replace("|", "\\|")
+        lines.append(
+            f"| `{r['name']}` | {r['type']} | {labels} | {help_text} |")
+    return "\n".join(lines) + "\n"
+
+
+def parse_catalog(text: str) -> list[dict]:
+    """The inverse of ``render_catalog`` — parse the committed
+    METRICS.md back into ``[{name, type, labels, help}]`` rows for the
+    drift checks."""
+    rows: list[dict] = []
+    for line in text.splitlines():
+        if not line.startswith("| `"):
+            continue
+        # split on UNESCAPED pipes only (render escapes '|' in help)
+        cells = [c.strip().replace("\\|", "|") for c in
+                 re.split(r"(?<!\\)\|", line.strip().strip("|"))]
+        if len(cells) != 4:
+            continue
+        name, kind, labels_cell, help_text = cells
+        labels = [] if labels_cell == "—" else [
+            l.strip().strip("`") for l in labels_cell.split(",")]
+        rows.append({"name": name.strip("`"), "type": kind,
+                     "labels": labels, "help": help_text})
+    return rows

@@ -10,6 +10,16 @@ exits nonzero on any new finding:
     python scripts/lint.py --select KAI041,KAI052
     python scripts/lint.py --select KAI101,KAI102,KAI105  # race only
 
+It also drift-checks the generated metrics catalog: the registrations
+in ``kai_scheduler_tpu/framework/metrics.py`` (extracted by AST, so
+this stays jax-free) must agree exactly — name, type, labels, help —
+with the committed ``docs/metrics/METRICS.md``.  Regenerate with::
+
+    python -m kai_scheduler_tpu.framework.metrics > docs/metrics/METRICS.md
+
+(``tests/test_metrics_catalog.py`` runs the same check against the
+LIVE registry, plus a meta-check that this AST extraction matches it.)
+
 Hook it up with::
 
     printf 'python scripts/lint.py || exit 1\n' >> .git/hooks/pre-commit
@@ -18,6 +28,7 @@ The full gate (AST lint + jaxpr probe) is
 ``python -m kai_scheduler_tpu.analysis``; the tier-1 suite runs it via
 ``tests/test_analysis.py``.
 """
+import ast
 import os
 import sys
 
@@ -25,6 +36,83 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from kai_scheduler_tpu.analysis.__main__ import main  # noqa: E402
+from kai_scheduler_tpu.utils.metrics import parse_catalog  # noqa: E402
+
+METRICS_SRC = os.path.join(REPO_ROOT, "kai_scheduler_tpu", "framework",
+                           "metrics.py")
+METRICS_DOC = os.path.join(REPO_ROOT, "docs", "metrics", "METRICS.md")
+
+
+def registered_metrics_ast(path: str = METRICS_SRC) -> list[dict]:
+    """Every ``registry.counter/gauge/histogram(...)`` registration in
+    the metrics module, extracted without importing it (importing the
+    framework package pulls jax; this wrapper must stay sub-second)."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    rows = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "registry"):
+            continue
+        args = list(node.args)
+        kwargs = {k.arg: k.value for k in node.keywords}
+        name_node = args[0] if args else kwargs.get("name")
+        help_node = args[1] if len(args) > 1 else kwargs.get("help")
+        labels_node = (args[2] if len(args) > 2
+                       else kwargs.get("label_names"))
+        name = name_node.value if isinstance(name_node,
+                                             ast.Constant) else None
+        if name is None:
+            continue
+        help_text = (help_node.value
+                     if isinstance(help_node, ast.Constant) else "")
+        labels = []
+        if isinstance(labels_node, (ast.Tuple, ast.List)):
+            labels = [e.value for e in labels_node.elts
+                      if isinstance(e, ast.Constant)]
+        rows.append({"name": name, "type": node.func.attr,
+                     "labels": labels,
+                     "help": " ".join(str(help_text).split())})
+    rows.sort(key=lambda r: r["name"])
+    return rows
+
+
+def check_metrics_doc() -> list[str]:
+    """Drift between the registrations and the committed catalog doc —
+    one message per divergence, empty when in sync."""
+    if not os.path.exists(METRICS_DOC):
+        return [f"{METRICS_DOC} is missing — regenerate with "
+                f"`python -m kai_scheduler_tpu.framework.metrics`"]
+    with open(METRICS_DOC, encoding="utf-8") as f:
+        doc_rows = {r["name"]: r for r in parse_catalog(f.read())}
+    src_rows = {r["name"]: r for r in registered_metrics_ast()}
+    problems = []
+    for name in sorted(src_rows.keys() - doc_rows.keys()):
+        problems.append(f"metric `{name}` is registered but missing "
+                        f"from docs/metrics/METRICS.md")
+    for name in sorted(doc_rows.keys() - src_rows.keys()):
+        problems.append(f"docs/metrics/METRICS.md lists `{name}` but "
+                        f"no such registration exists")
+    for name in sorted(src_rows.keys() & doc_rows.keys()):
+        for field in ("type", "labels", "help"):
+            if src_rows[name][field] != doc_rows[name][field]:
+                problems.append(
+                    f"metric `{name}` {field} drifted: registered "
+                    f"{src_rows[name][field]!r} != documented "
+                    f"{doc_rows[name][field]!r}")
+    if problems:
+        problems.append("regenerate: python -m "
+                        "kai_scheduler_tpu.framework.metrics "
+                        "> docs/metrics/METRICS.md")
+    return problems
+
 
 if __name__ == "__main__":
-    sys.exit(main(["--no-probe", "--root", REPO_ROOT, *sys.argv[1:]]))
+    rc = main(["--no-probe", "--root", REPO_ROOT, *sys.argv[1:]])
+    drift = check_metrics_doc()
+    for msg in drift:
+        print(f"METRICS-DOC DRIFT: {msg}", file=sys.stderr)
+    sys.exit(rc or (1 if drift else 0))

@@ -1,0 +1,73 @@
+"""Metrics-catalog meta-tests (tier-1): the registry and the generated
+``docs/metrics/METRICS.md`` must agree EXACTLY — name, type, labels,
+help — so the catalog can never silently drift (the reference ships
+``docs/metrics/METRICS.md`` as a maintained artifact; ours is
+generated and drift-gated instead).
+
+Three directions are pinned:
+
+1. live registry  == committed doc     (the doc is truthful);
+2. lint extractor == live registry     (scripts/lint.py's jax-free AST
+   extraction stays honest, so the pre-commit gate checks the same
+   facts this test does);
+3. render/parse round-trips            (the doc format is lossless).
+"""
+import importlib.util
+import os
+
+import pytest
+
+from kai_scheduler_tpu.framework import metrics
+from kai_scheduler_tpu.utils.metrics import parse_catalog, render_catalog
+
+pytestmark = pytest.mark.core
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(ROOT, "docs", "metrics", "METRICS.md")
+
+
+def _normalized_registry():
+    rows = metrics.catalog()
+    for r in rows:
+        r["help"] = " ".join(str(r["help"]).split())
+    return rows
+
+
+def _load_lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "kai_lint_wrapper", os.path.join(ROOT, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_catalog_doc_exists_and_matches_registry_exactly():
+    assert os.path.exists(DOC), (
+        "docs/metrics/METRICS.md missing — regenerate with "
+        "`python -m kai_scheduler_tpu.framework.metrics`")
+    with open(DOC, encoding="utf-8") as f:
+        doc_rows = parse_catalog(f.read())
+    assert doc_rows == _normalized_registry(), (
+        "docs/metrics/METRICS.md drifted from the registry — "
+        "regenerate with `python -m kai_scheduler_tpu.framework."
+        "metrics > docs/metrics/METRICS.md`")
+
+
+def test_lint_ast_extraction_matches_registry():
+    """The jax-free extractor scripts/lint.py uses must see the same
+    catalog the live registry reports — otherwise the pre-commit gate
+    and this tier-1 gate could certify different facts."""
+    lint = _load_lint_module()
+    assert lint.registered_metrics_ast() == _normalized_registry()
+    assert lint.check_metrics_doc() == []
+
+
+def test_render_parse_round_trip():
+    rows = _normalized_registry()
+    assert parse_catalog(render_catalog(rows)) == rows
+
+
+def test_every_metric_has_help_and_kai_prefix():
+    for r in metrics.catalog():
+        assert r["name"].startswith("kai_"), r["name"]
+        assert r["help"].strip(), f"{r['name']} has no help text"
