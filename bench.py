@@ -453,6 +453,34 @@ def _churn_cluster(cluster, rng, frac: float,
     cluster.tick()
 
 
+def _wire_totals() -> dict:
+    """Cumulative per-reason transfer-ledger aggregates (kai-wire)."""
+    from kai_scheduler_tpu.runtime.wire_ledger import LEDGER
+    return LEDGER.totals()["by_reason"]
+
+
+def _wire_delta(before: dict, after: dict, cycles: int) -> dict:
+    """Per-cycle (total, patch, redundant) bytes-on-the-wire between
+    two ledger totals snapshots — the BENCH_r06+ wire columns."""
+    def diff(field, reason=None):
+        tot = 0
+        for r, t in after.items():
+            if reason is not None and r != reason:
+                continue
+            tot += t[field] - before.get(r, {}).get(field, 0)
+        return tot
+
+    n = max(1, cycles)
+    return {
+        "total": round(diff("bytes") / n),
+        "patch": round(diff("bytes", "journal-patch") / n),
+        "redundant": round(diff("redundant_bytes") / n),
+        "redundant_patch": round(
+            diff("redundant_bytes", "journal-patch") / n),
+        "dispatches": round(diff("dispatches") / n, 2),
+    }
+
+
 def bench_churn(iters: int) -> dict:
     """Snapshot-refresh latency vs churn — the incremental snapshot
     engine (state/incremental.py) against the full ``build_snapshot``
@@ -499,6 +527,7 @@ def bench_churn(iters: int) -> dict:
                         (0.10, "10pct")):
         times = []
         before = snap.stats.patched
+        wire_before = _wire_totals()
         for _ in range(max(5, iters)):
             _churn_cluster(cluster, rng, frac)
             t0 = time.perf_counter()
@@ -508,8 +537,16 @@ def bench_churn(iters: int) -> dict:
         extra[f"refresh_p99_ms_{label}"] = round(p99, 1)
         extra[f"speedup_vs_full_{label}"] = round(full_p99 / p99, 1)
         extra[f"patched_cycles_{label}"] = snap.stats.patched - before
+        # kai-wire: measured bytes-on-the-wire per refresh (total /
+        # patch-path / redundant re-uploaded-identical — the ROADMAP-1
+        # invariant, 0 on the patch path), from the transfer-ledger
+        # per-reason deltas over this label's cycles
+        extra[f"wire_bytes_per_cycle_{label}"] = _wire_delta(
+            wire_before, _wire_totals(), len(times))
         if label == "1pct":
             p99_1pct = p99
+            extra["wire_bytes_per_cycle"] = \
+                extra["wire_bytes_per_cycle_1pct"]
     extra["fallbacks"] = dict(snap.stats.fallbacks)
     return {"metric": ("incremental snapshot refresh p99 @ 1% churn, "
                        "10k nodes x 50k pods (vs "
@@ -548,6 +585,7 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
 
     walls: list[float] = []
     acc: dict[str, list[float]] = {}
+    wires: list[tuple[int, int, int, int]] = []
     for _ in range(max(5, iters)):
         _churn_cluster(cluster, rng, 0.01, num_nodes)
         t0 = time.perf_counter()
@@ -555,6 +593,11 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
         walls.append(time.perf_counter() - t0)
         for k, v in res.phase_seconds.items():
             acc.setdefault(k, []).append(v)
+        # kai-wire per-cycle summary rides CycleResult.wire
+        patch = res.wire["by_reason"].get("journal-patch", {})
+        wires.append((res.wire["bytes"], patch.get("bytes", 0),
+                      res.wire["redundant_bytes"],
+                      patch.get("redundant_bytes", 0)))
     wall_mean = float(np.mean(walls))
     phases_ms = {k: round(float(np.mean(v)) * 1e3, 2)
                  for k, v in acc.items()}
@@ -574,6 +617,17 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
                            if snap is not None else 0),
         "fallbacks": (dict(snap.stats.fallbacks)
                       if snap is not None else {}),
+        # measured bytes-on-the-wire per cycle next to the phase
+        # attribution (total / patch-path / redundant) — redundant must
+        # read 0 while cycles stay on the patch path (ROADMAP-1's soak
+        # invariant, now measured in every BENCH_r06+ artifact)
+        "wire_bytes_per_cycle": {
+            "total": round(float(np.mean([w[0] for w in wires]))),
+            "patch": round(float(np.mean([w[1] for w in wires]))),
+            "redundant": round(float(np.mean([w[2] for w in wires]))),
+            "redundant_patch": round(
+                float(np.mean([w[3] for w in wires]))),
+        },
     }
     return {"metric": (f"cycle phase attribution p99 @ {num_nodes} "
                        f"nodes x {num_gangs * tasks_per_gang} pods, "

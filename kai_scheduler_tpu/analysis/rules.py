@@ -10,6 +10,8 @@ Code families (stable — suppressions and baselines reference them):
 * ``KAI041``        determinism hazards
 * ``KAI051-KAI052`` generic hygiene
 * ``KAI061``        observability discipline (tracer calls in traces)
+* ``KAI071``        wire discipline (raw device transfers outside the
+  ledger choke point)
 
 "Jit region" is the transitive call graph grown from the package's
 ``jax.jit`` entry points (see ``callgraph.py``); host-only code is
@@ -649,6 +651,62 @@ def _tracer_in_jit(ctx: RuleCtx) -> Iterator[Finding]:
                 f"compilation, not execution, and its timestamps would "
                 f"be meaningless.  Instrument around the dispatch on "
                 f"the host path instead", qual)
+
+
+# ---------------------------------------------------------------------------
+# KAI071 — wire discipline
+
+#: the TransferLedger choke point: the only module allowed to touch
+#: the raw host↔device transfer API.  Every other call site must route
+#: through ``wire_ledger.LEDGER.device_put`` so per-leaf upload
+#: accounting (bytes, reasons, redundancy — the ROADMAP-1 evidence
+#: layer) can never silently rot as code grows.
+_WIRE_CHOKE_POINT = frozenset({
+    "kai_scheduler_tpu/runtime/wire_ledger.py",
+})
+
+
+@rule(
+    "KAI071", "raw jax.device_put/device_get outside the wire-ledger "
+    "choke point",
+    bad="""
+import jax
+
+def ship(x):
+    return jax.device_put(x)
+""",
+    good="""
+from kai_scheduler_tpu.runtime.wire_ledger import LEDGER
+
+def ship(x):
+    return LEDGER.device_put(x, reason="full-build")
+""")
+def _raw_device_transfer(ctx: RuleCtx) -> Iterator[Finding]:
+    if ctx.mod.relpath in _WIRE_CHOKE_POINT:
+        return
+    _index_descendants(ctx)
+    for node in ast.walk(ctx.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _jax_attr(ctx, node.func)
+        if attr == "device_put":
+            yield ctx.finding(
+                "KAI071", node,
+                "raw jax.device_put bypasses the TransferLedger — "
+                "every host→device transfer must flow through "
+                "runtime/wire_ledger.LEDGER.device_put so per-leaf "
+                "bytes, reasons, and redundancy stay on the books "
+                "(ROADMAP-1's measurement substrate)",
+                _in_function(ctx, node) or "")
+        elif attr == "device_get":
+            yield ctx.finding(
+                "KAI071", node,
+                "raw jax.device_get is an unaccounted device→host "
+                "readback — the package's D2H budget is ONE packed "
+                "commit transfer per cycle (Session.gather_host); "
+                "route readbacks through the packed commit bundle "
+                "instead of ad-hoc transfers the wire ledger cannot "
+                "see", _in_function(ctx, node) or "")
 
 
 # ---------------------------------------------------------------------------

@@ -97,6 +97,63 @@ profiler_push_errors = registry.counter(
     "kai_profiler_push_errors_total",
     "Continuous-profiler window pushes that failed (swallowed after "
     "counting — a profiling sink never affects scheduling)")
+# kai-wire transfer ledger (runtime/wire_ledger.py): every host→device
+# upload in the package flows through the TransferLedger choke point
+# (KAI071), labeled with WHY it shipped — full-build (build_snapshot's
+# one-shot transfer), journal-patch (incremental changed-leaves ship),
+# fallback (incremental engine rebuilt in full), verify (patched==fresh
+# reference rebuild), mesh-shard (mesh placement).
+wire_uploaded_bytes = registry.counter(
+    "kai_wire_uploaded_bytes_total",
+    "Bytes shipped host→device through the transfer ledger",
+    label_names=("reason",))
+wire_uploaded_leaves = registry.counter(
+    "kai_wire_uploaded_leaves_total",
+    "Pytree leaves shipped host→device through the transfer ledger",
+    label_names=("reason",))
+wire_dispatches = registry.counter(
+    "kai_wire_dispatches_total",
+    "device_put dispatch calls (one batched dispatch may carry many "
+    "leaves — leaves/dispatches exposes unbatched transfer loops)",
+    label_names=("reason",))
+wire_redundant_bytes = registry.counter(
+    "kai_wire_redundant_bytes_total",
+    "Re-uploaded-IDENTICAL bytes: the uploaded leaf's content "
+    "fingerprint matched the last upload of the same leaf — the "
+    "invariant ROADMAP item 1 must drive to zero on the patch path",
+    label_names=("reason",))
+wire_dispatch_seconds = registry.counter(
+    "kai_wire_dispatch_seconds_total",
+    "Wall seconds spent in device_put dispatch calls (async enqueue, "
+    "not transfer completion — that is the cycle's device_wait phase)",
+    label_names=("reason",))
+wire_resident_bytes = registry.gauge(
+    "kai_wire_resident_bytes",
+    "Ledger-known device-resident bytes (last upload per leaf key)")
+wire_resident_buffers = registry.gauge(
+    "kai_wire_resident_buffers",
+    "Ledger-known device-resident buffer count")
+wire_cycle_uploaded_bytes = registry.histogram(
+    "kai_wire_cycle_uploaded_bytes",
+    "Per-cycle bytes on the wire (all reasons; observed at cycle roll)",
+    buckets=(4096.0, 65536.0, 1048576.0, 4194304.0, 16777216.0,
+             67108864.0, 268435456.0, 1073741824.0))
+# kai-wire compile watcher (runtime/compile_watch.py): every jit entry
+# point of the package is wrapped, and each first-seen abstract shape
+# signature is attributed as that entry's compile
+compile_cache_misses = registry.counter(
+    "kai_compile_cache_misses_total",
+    "Jit cache misses attributed per entry point (first call with an "
+    "unseen abstract shape signature)", label_names=("entry",))
+compile_seconds = registry.counter(
+    "kai_compile_seconds_total",
+    "Wall seconds spent in cache-miss dispatches (trace + XLA compile "
+    "dominated)", label_names=("entry",))
+compile_storm_alarms = registry.counter(
+    "kai_compile_storm_alarms_total",
+    "Recompile-storm alarms: misses on one entry reached the storm "
+    "threshold inside the sliding window (padded-capacity oscillation "
+    "or unstable static config)", label_names=("entry",))
 
 
 def catalog() -> list[dict]:

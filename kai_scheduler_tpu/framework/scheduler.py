@@ -28,13 +28,17 @@ from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
                             init_result)
 from ..ops.stale import stale_gang_eviction
 from ..ops.victims import run_victim_action, run_victim_action_jit
+from ..runtime import compile_watch
+from ..runtime import wire_ledger as _wire
 from ..runtime.cluster import Cluster
 from ..runtime.events import DecisionLog
 from ..runtime.tracing import CycleTracer
 from .session import Session, SessionConfig
 
-stale_eviction_jit = functools.partial(jax.jit, static_argnames=(
-    "grace_s", "num_levels"))(stale_gang_eviction)
+stale_eviction_jit = compile_watch.watch(
+    "stale_gang_eviction",
+    functools.partial(jax.jit, static_argnames=(
+        "grace_s", "num_levels"))(stale_gang_eviction))
 
 #: pure (unjitted) action bodies — composed into ONE jitted program per
 #: cycle when every configured action is built in.  Separate per-action
@@ -79,6 +83,11 @@ def _fused_pipeline(state, fair_share, *, actions, num_levels, acfg,
                        grace_s=grace_s)
 
 
+# kai-wire compile watcher: per-(entry, signature) cache-miss
+# attribution (runtime/compile_watch.py)
+_fused_pipeline = compile_watch.watch("fused_pipeline", _fused_pipeline)
+
+
 @dataclasses.dataclass
 class CycleResult:
     """Everything one ``runOnce`` decided (the Statement commit set)."""
@@ -106,6 +115,10 @@ class CycleResult:
     #: device_wait / host_decode / commit, so the phases sum to the
     #: cycle wall time by construction (see runtime/tracing.py)
     phase_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
+    #: kai-wire per-cycle transfer summary (runtime/wire_ledger.py):
+    #: bytes/leaves/dispatches/redundant-bytes by reason plus the
+    #: device-residency gauge — the ledger window rolled at cycle end
+    wire: dict = dataclasses.field(default_factory=dict)
 
 
 class Action(Protocol):
@@ -484,6 +497,16 @@ class Scheduler:
             self.decisions.record_cycle(trace.cycle_id, events,
                                         dropped=dropped, counts=counts)
             self._record_metrics(session, result, host)
+            # kai-wire: close this cycle's transfer window.  The
+            # summary rides the result (healthz/bench) and the trace as
+            # Chrome counter lanes — bytes-on-wire and live-bytes step
+            # charts aligned with the phase spans above.
+            result.wire = _wire.LEDGER.roll_cycle(trace.cycle_id)
+            trace.counters.append(("wire bytes/cycle", {
+                "uploaded": result.wire["bytes"],
+                "redundant": result.wire["redundant_bytes"]}))
+            trace.counters.append(("device resident bytes", {
+                "live": result.wire["resident_bytes"]}))
         t_end = time.perf_counter()
         result.phase_seconds = {
             "snapshot": max(0.0, open_s - upload_s),

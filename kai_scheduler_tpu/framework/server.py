@@ -21,6 +21,11 @@ Reference surfaces collapse into one stdlib HTTP server:
   (``runtime/events.py``): every considered gang's cycle outcome
   (allocated / fit-failure / quota-gate / preempted-for);
   ``?gang=<name>`` filters to one pod group.
+- ``GET /debug/wire``   — the kai-wire transfer ledger + compile
+  watcher (``runtime/wire_ledger.py`` / ``runtime/compile_watch.py``):
+  per-cycle, per-leaf host→device upload events with redundancy
+  accounting, the device-residency gauge, and per-entry jit cache-miss
+  attribution (``?cycles=`` bounds the ring window).
 
 The server is deliberately dependency-free (http.server); a production
 deployment would front it with gRPC — the payloads are already the
@@ -38,6 +43,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..runtime import compile_watch, wire_ledger
 from ..runtime.cluster import Cluster
 from ..runtime.snapshot import dump_cluster, load_cluster
 from . import metrics
@@ -301,6 +307,26 @@ class SchedulerServer:
                     self._send({"gang": gang,
                                 "events": log.events(gang=gang),
                                 "summary": log.summary()})
+                elif self.path.startswith("/debug/wire"):
+                    # kai-wire transfer ledger + compile watcher: the
+                    # rolled per-cycle upload ring (?cycles= bounds),
+                    # residency gauge, and per-entry compile-miss
+                    # attribution.  Computed OUTSIDE _state_lock —
+                    # ledger/watcher are process-global and internally
+                    # locked, ring entries are immutable once rolled,
+                    # so the document can never tear and never stalls
+                    # a concurrent cycle POST.
+                    params = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        cycles = (int(params["cycles"][0])
+                                  if "cycles" in params else None)
+                    except ValueError:
+                        self.send_error(400, "cycles must be an integer")
+                        return
+                    doc = wire_ledger.LEDGER.wire_doc(cycles=cycles)
+                    doc["compile"] = compile_watch.WATCHER.report()
+                    self._send(doc)
                 elif self.path.startswith("/debug/pprof/continuous"):
                     # the continuous-profiling (Pyroscope) analogue:
                     # retained folded-stack windows (profiler state is
@@ -437,7 +463,10 @@ class SchedulerServer:
                 phase_seconds=dict(result.phase_seconds),
                 decisions=self.scheduler.decisions.summary(),
                 bind_requests=len(result.bind_requests),
-                evictions=len(result.evictions))
+                evictions=len(result.evictions),
+                # kai-wire summary of the cycle: bytes on the wire by
+                # reason, redundant re-uploads, device residency
+                wire=dict(result.wire))
         self._cycle_stats = stats
 
     def start(self) -> "SchedulerServer":

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..apis import types as apis
 from ..ops import drf
+from ..runtime import compile_watch
 from ..runtime import events as gang_events
 from ..ops.allocate import AllocateConfig, AllocationResult
 from ..ops.victims import VictimConfig
@@ -35,8 +36,10 @@ from ..state.cluster_state import (ClusterState, SnapshotIndex,
 #: while_loop re-traces (and recompiles) every cycle — measured ~2.5 s per
 #: Session.open at 10k nodes vs ~ms jitted.  ``k_value`` rides as a traced
 #: array so sweeping it never recompiles.
-_set_fair_share_jit = functools.partial(
-    jax.jit, static_argnames=("num_levels",))(drf.set_fair_share)
+_set_fair_share_jit = compile_watch.watch(
+    "set_fair_share",
+    functools.partial(
+        jax.jit, static_argnames=("num_levels",))(drf.set_fair_share))
 
 #: The commit-path host bundle.  Two principles keep it small — it moves
 #: through a tunneled TPU link whose D2H costs ~70 ms + ~0.2 ms/KB:
@@ -85,6 +88,11 @@ def _pack_commit(result: AllocationResult, state: ClusterState,
         parts.append(
             (result.placement_device + 1).ravel().astype(jnp.int16))
     return jnp.concatenate(parts)
+
+
+# kai-wire compile watcher: per-(entry, signature) cache-miss
+# attribution (runtime/compile_watch.py)
+_pack_commit = compile_watch.watch("pack_commit", _pack_commit)
 
 
 def _pow4_ceil(x: int) -> int:

@@ -44,6 +44,7 @@ from flax import struct
 from jax import lax
 
 from ..apis.types import UNLIMITED
+from ..runtime import compile_watch
 from ..state.cluster_state import ClusterState
 from . import ordering
 from .predicates import feasible_nodes, feasible_nodes_dual, node_portion
@@ -1883,3 +1884,8 @@ def allocate_jit(state: ClusterState, fair_share: jax.Array, *,
                  init: AllocationResult | None = None) -> AllocationResult:
     return allocate(state, fair_share, num_levels=num_levels, config=config,
                     init=init)
+
+
+# kai-wire compile watcher: attribute every cache miss of this entry to
+# its (entry, abstract-shape-signature) pair (runtime/compile_watch.py)
+allocate_jit = compile_watch.watch("allocate", allocate_jit)

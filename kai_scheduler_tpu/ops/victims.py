@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..apis.types import UNLIMITED
+from ..runtime import compile_watch
 from ..utils.numerics import cumsum_ds
 from ..state.cluster_state import ClusterState
 from . import ordering
@@ -1928,3 +1929,9 @@ def run_victim_action_jit(state, fair_share, result, *, num_levels,
     return run_victim_action(state, fair_share, result,
                              num_levels=num_levels, mode=mode,
                              config=config)
+
+
+# kai-wire compile watcher: per-(entry, signature) cache-miss
+# attribution (runtime/compile_watch.py)
+run_victim_action_jit = compile_watch.watch("run_victim_action",
+                                            run_victim_action_jit)

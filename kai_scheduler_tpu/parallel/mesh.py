@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime import wire_ledger
 from ..state.cluster_state import ClusterState
 
 NODE_AXIS = "nodes"
@@ -67,4 +68,10 @@ def shard_state(state: ClusterState, mesh: Mesh, axis: str = NODE_AXIS) -> Clust
         raise ValueError(
             f"node axis {n} not divisible by mesh size {mesh.size}; "
             f"build the snapshot with pad={mesh.size}")
-    return jax.device_put(state, state_shardings(state, mesh, axis))
+    # through the kai-wire TransferLedger (KAI071): mesh placements get
+    # their own residency site — sharded buffers supersede each other,
+    # never the single-device snapshot's
+    return wire_ledger.LEDGER.device_put(
+        state, state_shardings(state, mesh, axis),
+        reason=wire_ledger.REASON_MESH_SHARD, site="mesh",
+        replace_site=True)

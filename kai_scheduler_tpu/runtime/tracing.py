@@ -80,6 +80,12 @@ class CycleTrace:
     wall_start: float
     #: the root "cycle" span; the phase spans are its children
     root: Span
+    #: ``(name, {series: value})`` samples appended before the cycle
+    #: closes — exported as Chrome "C" (counter) events at the cycle's
+    #: start timestamp, so per-cycle scalars (kai-wire bytes-on-wire,
+    #: device-resident bytes) render as step charts aligned with the
+    #: phase lanes
+    counters: list = dataclasses.field(default_factory=list)
 
     def phase_seconds(self) -> dict[str, float]:
         """Top-level (phase) span durations by name.
@@ -244,6 +250,12 @@ class CycleTracer:
                     "ph": "M", "name": "thread_name", "pid": 0,
                     "tid": tid, "args": {"name": f"cycle-{t.cycle_id}"},
                 })
-                _emit_span(events, t.root, (t.wall_start - epoch) * 1e6,
-                           t.root.start, tid)
+                origin_us = (t.wall_start - epoch) * 1e6
+                _emit_span(events, t.root, origin_us, t.root.start, tid)
+                for cname, values in t.counters:
+                    events.append({
+                        "ph": "C", "name": str(cname), "pid": 0,
+                        "tid": tid, "ts": round(origin_us, 3),
+                        "args": _clean_attrs(dict(values)),
+                    })
         return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -32,6 +32,7 @@ import numpy as np
 from flax import struct
 
 from ..apis import types as apis
+from ..runtime import wire_ledger as _wire
 from . import node_filters
 
 UNLIMITED = apis.UNLIMITED
@@ -1725,7 +1726,11 @@ def build_snapshot(
         running=RunningState(**rk),
     )
     host_state = state
-    state = jax.device_put(state)
+    # through the kai-wire TransferLedger (the package's device_put
+    # choke point, KAI071): the full snapshot supersedes the previous
+    # one's buffers, so the upload replaces the ledger's resident set
+    state = _wire.LEDGER.device_put(
+        state, reason=_wire.REASON_FULL_BUILD, replace_site=True)
     index = SnapshotIndex(
         node_names=node_names,
         queue_names=queue_names,

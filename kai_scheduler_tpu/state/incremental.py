@@ -61,6 +61,7 @@ import jax
 import numpy as np
 
 from ..apis import types as apis
+from ..runtime import wire_ledger as _wire
 from . import cluster_state as _cs
 from .cluster_state import (
     SnapshotCapacity,
@@ -363,7 +364,7 @@ class IncrementalSnapshotter:
                     "dirty_pods": self._last_dirty[0],
                     "dirty_gangs": self._last_dirty[1],
                     "leaves_shipped": ship[0], "bytes_shipped": ship[1],
-                    "ship_seconds": ship[2],
+                    "ship_seconds": ship[2], "ship_dispatches": ship[3],
                 }
                 self._add_span("snapshot.patch", t_patch,
                                **self.stats.last)
@@ -380,7 +381,7 @@ class IncrementalSnapshotter:
             "mode": "full", "fallback_reason": reason,
             "dirty_pods": 0, "dirty_gangs": 0,
             "leaves_shipped": 0, "bytes_shipped": 0,
-            "ship_seconds": 0.0,
+            "ship_seconds": 0.0, "ship_dispatches": 0,
         }
         self._add_span("snapshot.full_build", t_full,
                        fallback_reason=reason)
@@ -445,14 +446,18 @@ class IncrementalSnapshotter:
             gangs=_slack(len(groups)), tasks=_slack(max_pending),
             running=_slack(n_running), types=0)
         # through the module attribute so test harnesses that wrap
-        # build_snapshot (padding unification) stay in effect
-        state, index, host = _cs.build_snapshot(
-            *lists, now=now, queue_usage=queue_usage,
-            resource_claims=cluster.resource_claims,
-            device_classes=cluster.device_classes,
-            volume_claims=cluster.volume_claims,
-            storage_classes=cluster.storage_classes,
-            capacity=cap, _return_host=True)
+        # build_snapshot (padding unification) stay in effect.  The
+        # wire ledger re-labels the build's transfer "fallback": the
+        # incremental engine rebuilt in full (cold start included) —
+        # distinguishable on /debug/wire from a deliberate full build
+        with _wire.LEDGER.override_reason(_wire.REASON_FALLBACK):
+            state, index, host = _cs.build_snapshot(
+                *lists, now=now, queue_usage=queue_usage,
+                resource_claims=cluster.resource_claims,
+                device_classes=cluster.device_classes,
+                volume_claims=cluster.volume_claims,
+                storage_classes=cluster.storage_classes,
+                capacity=cap, _return_host=True)
         # the per-entity ledger only pays off if a later cycle can
         # actually patch — skip it (stay cold) while a persistent
         # environment condition forces full rebuilds regardless, e.g. a
@@ -1474,37 +1479,69 @@ class IncrementalSnapshotter:
         previous device buffers (and their previous host objects, so the
         next cycle's compares short-circuit on identity).  The transfer
         section is timed (and span-recorded) as the cycle's "upload"
-        phase."""
+        phase.
+
+        All changed leaves ship in ONE batched ``device_put`` (a
+        ``{keystr: array}`` dict, mirroring ``build_snapshot``'s
+        one-shot pattern) through the kai-wire TransferLedger — the
+        previous per-leaf loop cost one dispatch round trip per changed
+        leaf through a tunneled TPU.  The ledger records both the
+        would-have-been dispatch count (``leaves``) and the actual one
+        (``dispatches`` == 1), keyed by the same leaf names the full
+        build uses so redundancy tracking spans both paths.
+        """
         t_ship = time.perf_counter()
-        leaves = bytes_ = 0
-        new_leaves, treedef = jax.tree_util.tree_flatten(host_new)
+        new_paths, treedef = jax.tree_util.tree_flatten_with_path(
+            host_new)
         old_leaves = jax.tree_util.tree_leaves(self._host)
         dev_leaves = jax.tree_util.tree_leaves(self._dev)
-        out_dev, out_host = [], []
-        for new, old, dev in zip(new_leaves, old_leaves, dev_leaves):
+        out_dev, out_host = list(dev_leaves), list(old_leaves)
+        changed: dict[str, object] = {}
+        slot: dict[str, int] = {}
+        bytes_ = 0
+        for i, ((path, new), old) in enumerate(zip(new_paths,
+                                                   old_leaves)):
+            # equal_nan on float leaves: a NaN-carrying leaf (e.g.
+            # unset stale timestamps) must not read as "changed"
+            # forever — the ledger would (rightly) flag the identical
+            # re-upload as redundant bytes every cycle
             if new is old or (
                     getattr(new, "shape", None) == old.shape
                     and new.dtype == old.dtype
-                    and np.array_equal(new, old)):
-                out_dev.append(dev)
-                out_host.append(old)
-            else:
-                leaves += 1
-                bytes_ += int(new.nbytes)
-                out_dev.append(jax.device_put(new))
-                out_host.append(new)
+                    and np.array_equal(new, old,
+                                       equal_nan=new.dtype.kind == "f")):
+                continue
+            name = jax.tree_util.keystr(path) or f"[{i}]"
+            changed[name] = new
+            slot[name] = i
+            out_host[i] = new
+            bytes_ += int(new.nbytes)
+        leaves = len(changed)
+        dispatches = 0
+        if changed:
+            dispatches = 1
+            # leaf_names must follow FLATTEN order, and jax flattens
+            # dict keys sorted — insertion (traversal) order would pair
+            # names with the wrong leaves whenever a patch spans
+            # sections (ClusterState fields don't sort alphabetically)
+            shipped = _wire.LEDGER.device_put(
+                changed, reason=_wire.REASON_JOURNAL_PATCH,
+                leaf_names=sorted(changed))
+            for name, dev in shipped.items():
+                out_dev[slot[name]] = dev
         self._host = jax.tree_util.tree_unflatten(treedef, out_host)
         self._dev = jax.tree_util.tree_unflatten(treedef, out_dev)
         ship_s = time.perf_counter() - t_ship
         self.stats.leaves_shipped += leaves
         self.stats.bytes_shipped += bytes_
-        self._last_ship = (leaves, bytes_, ship_s)
+        self._last_ship = (leaves, bytes_, ship_s, dispatches)
         # NOT a device_sync span: jax.device_put is async, so this times
         # the transfer DISPATCH (flatten + compares + enqueue); the
         # transfer itself overlaps the solve and completion is absorbed
         # by the cycle's device_wait sync — exactly the async-attribution
         # rule the tracer exists to make explicit
-        self._add_span("upload", t_ship, leaves=leaves, bytes=bytes_)
+        self._add_span("upload", t_ship, leaves=leaves, bytes=bytes_,
+                       dispatches=dispatches)
         return self._dev
 
     # -- verification ------------------------------------------------------
@@ -1512,13 +1549,17 @@ class IncrementalSnapshotter:
     def _verify(self, cluster, now, queue_usage) -> None:
         """Assert the patched snapshot equals a fresh full rebuild,
         element-wise, including the index name maps."""
-        _, fresh_index, fresh_host = _cs.build_snapshot(
-            *cluster.snapshot_lists(), now=now, queue_usage=queue_usage,
-            resource_claims=cluster.resource_claims,
-            device_classes=cluster.device_classes,
-            volume_claims=cluster.volume_claims,
-            storage_classes=cluster.storage_classes,
-            capacity=self._capacity, _return_host=True)
+        # reason "verify" on the wire ledger: the reference rebuild's
+        # transfer is deliberate re-upload, not patch-path redundancy
+        with _wire.LEDGER.override_reason(_wire.REASON_VERIFY):
+            _, fresh_index, fresh_host = _cs.build_snapshot(
+                *cluster.snapshot_lists(), now=now,
+                queue_usage=queue_usage,
+                resource_claims=cluster.resource_claims,
+                device_classes=cluster.device_classes,
+                volume_claims=cluster.volume_claims,
+                storage_classes=cluster.storage_classes,
+                capacity=self._capacity, _return_host=True)
         paths_new = jax.tree_util.tree_flatten_with_path(self._host)[0]
         paths_ref = jax.tree_util.tree_flatten_with_path(fresh_host)[0]
         for (path, mine), (_, ref) in zip(paths_new, paths_ref):
