@@ -18,6 +18,11 @@ pending pods**, p99 cycle latency against the driver's 50 ms bar
   phases        kai-trace per-phase cycle attribution (snapshot/upload/
                 solve-dispatch/device-wait/host-decode/commit) @ 10k
                 nodes × 50k pods, 1% journaled churn
+  frag          kai-pulse fragmentation scenario: 10k nodes, 70k
+                running fillers strand 10k single devices across 40
+                racks; a rack-required 256-pod gang is unplaceable
+                until a rack frees — measures analytics overhead and
+                the gauge's predictive drop
   headline      10k nodes × 50k pods allocate
   e2e/e2e_alloc full cycle (snapshot→actions→commit), saturated /
                 allocate-heavy shapes
@@ -254,7 +259,8 @@ def bench_headline_full(iters: int) -> dict:
                      ("reclaim", bench_reclaim),
                      ("preempt_many_queues", bench_preempt_many_queues),
                      ("churn", bench_churn),
-                     ("phases", bench_phases)):
+                     ("phases", bench_phases),
+                     ("frag", bench_frag)):
         try:
             r = fn(max(3, iters // 2))
             extra[name] = {"p99_ms": r["value"],
@@ -586,11 +592,13 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
     walls: list[float] = []
     acc: dict[str, list[float]] = {}
     wires: list[tuple[int, int, int, int]] = []
+    an_dispatch: list[float] = []
     for _ in range(max(5, iters)):
         _churn_cluster(cluster, rng, 0.01, num_nodes)
         t0 = time.perf_counter()
         res = sched.run_once(cluster)
         walls.append(time.perf_counter() - t0)
+        an_dispatch.append(res.analytics_seconds)
         for k, v in res.phase_seconds.items():
             acc.setdefault(k, []).append(v)
         # kai-wire per-cycle summary rides CycleResult.wire
@@ -628,6 +636,19 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
             "redundant_patch": round(
                 float(np.mean([w[3] for w in wires]))),
         },
+        # kai-pulse rides every cycle here (analytics_every=1 default):
+        # host dispatch cost of the analytics pass + the BENCH_r06+
+        # cluster-health tracking columns from the last cycle
+        "analytics_dispatch_ms": round(
+            float(np.mean(an_dispatch)) * 1e3, 2),
+        "analytics_pct_of_wall": round(
+            float(np.mean(an_dispatch)) / max(wall_mean, 1e-12) * 100,
+            2),
+        "fragmentation": res.analytics.get(
+            "fragmentation", {}).get("score"),
+        "goodput": res.analytics.get("goodput"),
+        "fairness_drift": res.analytics.get(
+            "fairness", {}).get("drift_max"),
     }
     return {"metric": (f"cycle phase attribution p99 @ {num_nodes} "
                        f"nodes x {num_gangs * tasks_per_gang} pods, "
@@ -635,6 +656,131 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
                        "device-wait/host-decode/commit)"),
             "value": round(wall_p99, 3), "unit": "ms",
             "vs_baseline": round(50.0 / max(wall_p99, 1e-9), 3),
+            "extra": extra}
+
+
+def _frag_cluster_10k(num_racks: int = 40, nodes_per_rack: int = 250,
+                      node_accel: int = 8, fill: int = 7,
+                      gang_pods: int = 256):
+    """A fragmented 10k-node cluster (ROADMAP item 5's scenario,
+    pre-staged): every node holds ``fill``/``node_accel`` devices of
+    NON-preemptible fillers, so each rack strands ``nodes_per_rack``
+    single free devices — a rack-required ``gang_pods``-pod gang is
+    cluster-feasible (10k free devices) but unplaceable in any single
+    rack until capacity consolidates."""
+    from kai_scheduler_tpu.apis import types as apis
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+    level = "topo/rack"
+    topo = apis.Topology(name="default",
+                         levels=[level, "kubernetes.io/hostname"])
+    nodes, pods, groups = [], [], []
+    queues = [
+        apis.Queue("fill", accel=apis.QueueResource(
+            quota=float(num_racks * nodes_per_rack * fill))),
+        apis.Queue("big", accel=apis.QueueResource(
+            quota=float(gang_pods)))]
+    for rack in range(num_racks):
+        g = apis.PodGroup(
+            f"fill-{rack}", queue="fill",
+            min_member=nodes_per_rack * fill,
+            preemptibility=apis.Preemptibility.NON_PREEMPTIBLE)
+        groups.append(g)
+        for j in range(nodes_per_rack):
+            i = rack * nodes_per_rack + j
+            name = f"node-{i}"
+            nodes.append(apis.Node(
+                name, apis.ResourceVec(node_accel, 64, 256),
+                labels={level: f"rack-{rack}",
+                        "kubernetes.io/hostname": name}))
+            for t in range(fill):
+                pods.append(apis.Pod(
+                    f"fill-{i}-{t}", g.name, apis.ResourceVec(1, 1, 4),
+                    status=apis.PodStatus.RUNNING, node=name))
+    gang = apis.PodGroup(
+        "big-gang", queue="big", min_member=gang_pods,
+        topology_constraint=apis.TopologyConstraint(
+            topology="default", required_level=level))
+    groups.append(gang)
+    for t in range(gang_pods):
+        pods.append(apis.Pod(f"big-{t}", "big-gang",
+                             apis.ResourceVec(1, 1, 4)))
+    return Cluster.from_objects(nodes, queues, groups, pods, topo)
+
+
+def bench_frag(iters: int) -> dict:
+    """kai-pulse fragmentation scenario @ 10k nodes / 70k running pods:
+    a rack-required 256-pod gang is unplaceable while ~10k free devices
+    sit stranded one-per-node across 40 racks.  Measures the full cycle
+    p99 WITH the analytics pass against an analytics-off twin (the
+    <10%-overhead acceptance bar), and proves the fragmentation gauge
+    is predictive: high while the gang is stranded, dropping once a
+    rack is freed and the gang places."""
+    import numpy as np
+
+    from kai_scheduler_tpu.framework.scheduler import (Scheduler,
+                                                       SchedulerConfig)
+
+    def timed_cycles(every: int):
+        cluster = _frag_cluster_10k()
+        sched = Scheduler(SchedulerConfig(analytics_every=every))
+        res = sched.run_once(cluster)  # compile
+        times, an_s = [], []
+        for _ in range(max(3, iters)):
+            t0 = time.perf_counter()
+            res = sched.run_once(cluster)
+            times.append(time.perf_counter() - t0)
+            an_s.append(res.analytics_seconds)
+        return _p99(times), float(np.mean(an_s)), res, sched, cluster
+
+    p99_on, analytics_ms, res, sched, cluster = timed_cycles(every=1)
+    analytics_ms *= 1e3
+    p99_off, _, _, _, _ = timed_cycles(every=0)
+    frag = res.analytics["fragmentation"]
+    stranded = {
+        "score": frag["score"],
+        "largest_rack_unit_pods": frag["largest_rack_unit_pods"],
+        "total_unit_pods": frag["total_unit_pods"],
+        "rung256_cluster_feasible": [
+            r["cluster_feasible"] for r in frag["gang_ladder"]
+            if r["pods"] == 256][0],
+        "rung256_rack_placeable": [
+            r["rack_placeable"] for r in frag["gang_ladder"]
+            if r["pods"] == 256][0],
+    }
+    # free one rack: evict 6 fillers on distinct rack-0 nodes so the
+    # rack holds 256 whole devices, reap, rerun — the gang must place
+    # and the gauge must drop
+    for i in range(6):
+        cluster.evict_pod(f"fill-{i}-0")
+    cluster.tick()
+    cluster.tick()
+    res2 = sched.run_once(cluster)
+    frag2 = res2.analytics["fragmentation"]
+    extra = {
+        "p99_ms_analytics_off": round(p99_off, 1),
+        "analytics_dispatch_ms": round(analytics_ms, 2),
+        "analytics_overhead_pct": round(
+            (p99_on - p99_off) / max(p99_off, 1e-9) * 100.0, 1),
+        "stranded": stranded,
+        "freed": {"score": frag2["score"],
+                  "largest_rack_unit_pods":
+                      frag2["largest_rack_unit_pods"],
+                  "binds": len(res2.bind_requests)},
+        # the BENCH_r06+ tracking columns
+        "fragmentation": stranded["score"],
+        "goodput": res.analytics["goodput"],
+        "fairness_drift": res.analytics["fairness"]["drift_max"],
+        "predictive": bool(
+            stranded["score"] > frag2["score"]
+            and len(res2.bind_requests) >= 256),
+    }
+    return {"metric": ("frag cycle p99 @ 10k nodes / 70k running pods, "
+                       "256-pod rack-required gang stranded "
+                       "(analytics ON; gauge "
+                       f"{stranded['score']}→{frag2['score']} after "
+                       "rack freed)"),
+            "value": round(p99_on, 3), "unit": "ms",
+            "vs_baseline": round(50.0 / max(p99_on, 1e-9), 3),
             "extra": extra}
 
 
@@ -748,6 +894,7 @@ CONFIGS = {
     "preempt_many_queues": bench_preempt_many_queues,
     "churn": bench_churn,
     "phases": bench_phases,
+    "frag": bench_frag,
     "headline": bench_headline,
     "e2e": bench_e2e,
     "e2e_alloc": bench_e2e_alloc,

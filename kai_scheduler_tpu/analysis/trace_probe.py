@@ -37,6 +37,7 @@ from ..framework.scheduler import (_fused_pipeline, run_actions,
                                    stale_eviction_jit)
 from ..framework.session import (SessionConfig, _pack_commit,
                                  _set_fair_share_jit)
+from ..ops import analytics as pulse
 from ..ops import drf
 from ..ops.allocate import (AllocateConfig, allocate, allocate_jit,
                             init_result)
@@ -204,10 +205,24 @@ def _registry() -> list[ProbeSpec]:
             "pack_commit",
             functools.partial(getattr(_pack_commit, "__wrapped__",
                                       _pack_commit),
-                              track_devices=False),
+                              track_devices=False,
+                              track_analytics=False),
             _pack_commit,
             lambda env: ((_probe_result(env), env[0]),
-                         dict(track_devices=False))),
+                         dict(track_devices=False,
+                              track_analytics=False))),
+        ProbeSpec(
+            # kai-pulse cluster-health kernel (ops/analytics.py): runs
+            # over the post-decision snapshot every K cycles and rides
+            # the packed commit — probed with a zeroed pending-age
+            # vector at the canonical shapes
+            "analytics",
+            functools.partial(pulse.cluster_analytics,
+                              config=pulse.AnalyticsConfig()),
+            pulse.cluster_analytics_jit,
+            lambda env: ((env[0], _probe_result(env),
+                          jnp.zeros((env[0].gangs.g,), jnp.float32)),
+                         dict(config=pulse.AnalyticsConfig()))),
         ProbeSpec(
             "cumsum_ds",
             numerics.cumsum_ds,

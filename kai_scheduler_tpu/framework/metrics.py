@@ -154,6 +154,55 @@ compile_storm_alarms = registry.counter(
     "Recompile-storm alarms: misses on one entry reached the storm "
     "threshold inside the sliding window (padded-capacity oscillation "
     "or unstable static config)", label_names=("entry",))
+# kai-pulse cluster-health analytics (ops/analytics.py): the on-device
+# gauge kernel that rides the packed commit every K cycles —
+# fragmentation, goodput/utilization, fairness drift, starvation
+cluster_fragmentation_score = registry.gauge(
+    "kai_cluster_fragmentation_score",
+    "Rack-stranded fraction of the canonical gang ladder: rungs the "
+    "cluster could serve by raw free unit pods but NO single rack "
+    "domain can host (0 = consolidated, 1 = fully stranded) — the "
+    "gauge the repack solver is gated behind")
+cluster_stranded_free_frac = registry.gauge(
+    "kai_cluster_stranded_free_frac",
+    "Fraction of free capacity sitting on nodes that cannot fit even "
+    "one canonical unit pod", label_names=("resource",))
+cluster_largest_rack_gang = registry.gauge(
+    "kai_cluster_largest_rack_gang_units",
+    "Canonical unit pods placeable inside the single best rack domain "
+    "(the largest-placeable-gang probe)")
+cluster_free_unit_pods = registry.gauge(
+    "kai_cluster_free_unit_pods",
+    "Canonical unit pods placeable cluster-wide (allocate fit "
+    "predicate over the post-cycle free pool)")
+cluster_utilization = registry.gauge(
+    "kai_cluster_utilization",
+    "Allocated / capacity per resource axis (post-cycle, releasing "
+    "counted as idle)", label_names=("resource",))
+cluster_goodput = registry.gauge(
+    "kai_cluster_goodput",
+    "Cluster goodput in Gavel's effective-throughput sense: running + "
+    "newly-bound accel throughput over accel capacity (unit throughput "
+    "per device until the per-(job, accel-type) tensors land)")
+cluster_fairness_drift = registry.gauge(
+    "kai_cluster_fairness_drift",
+    "Per-queue max_r |allocated - DRF fair share| / cluster capacity",
+    label_names=("queue",))
+cluster_fairness_drift_max = registry.gauge(
+    "kai_cluster_fairness_drift_max",
+    "Largest per-queue fairness drift this analytics cycle")
+cluster_fairness_drift_gini = registry.gauge(
+    "kai_cluster_fairness_drift_gini",
+    "Gini coefficient of the dominant allocated shares across valid "
+    "queues (0 = equal, 1 = maximally concentrated)")
+cluster_pending_gangs = registry.gauge(
+    "kai_cluster_pending_gangs",
+    "Gangs still pending after the cycle (kai-pulse starvation family)")
+gang_starvation_age = registry.gauge(
+    "kai_gang_starvation_age_cycles",
+    "Pending age in cycles for the top-K oldest starving gangs (the "
+    "kai-pulse on-device top-K table; series update on analytics "
+    "cycles)", label_names=("gang",))
 
 
 def catalog() -> list[dict]:

@@ -26,14 +26,16 @@ import numpy as np
 from ..apis import types as apis
 from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
                             init_result)
+from ..ops.analytics import cluster_analytics_jit
 from ..ops.stale import stale_gang_eviction
 from ..ops.victims import run_victim_action, run_victim_action_jit
 from ..runtime import compile_watch
 from ..runtime import wire_ledger as _wire
 from ..runtime.cluster import Cluster
+from ..runtime import events as gang_events
 from ..runtime.events import DecisionLog
 from ..runtime.tracing import CycleTracer
-from .session import Session, SessionConfig
+from .session import FIT_REASONS, Session, SessionConfig
 
 stale_eviction_jit = compile_watch.watch(
     "stale_gang_eviction",
@@ -119,6 +121,12 @@ class CycleResult:
     #: bytes/leaves/dispatches/redundant-bytes by reason plus the
     #: device-residency gauge — the ledger window rolled at cycle end
     wire: dict = dataclasses.field(default_factory=dict)
+    #: kai-pulse cluster-health document (ops/analytics.py) — empty on
+    #: cycles the analytics cadence skipped (``analytics_every``)
+    analytics: dict = dataclasses.field(default_factory=dict)
+    #: host-side dispatch cost of the analytics pass (the device work
+    #: itself overlaps the solve and lands in ``device_wait``)
+    analytics_seconds: float = 0.0
 
 
 class Action(Protocol):
@@ -243,6 +251,13 @@ class SchedulerConfig:
     verify_incremental: bool = False
     #: dirty fraction above which patching falls back to a full rebuild
     incremental_dirty_threshold: float = 0.35
+    #: kai-pulse cadence: run the cluster-health analytics kernel every
+    #: K cycles (1 = every cycle, 0 = off).  Skipped cycles pay nothing
+    #: — no dispatch, no extra bytes on the packed commit transfer.
+    analytics_every: int = 1
+    #: pending age (in cycles) at which a gang fires a ``starved``
+    #: DecisionLog event + the starvation alarm gauges; 0 disables
+    starvation_alarm_cycles: int = 32
 
 
 def apply_shard_args(session: SessionConfig,
@@ -315,6 +330,23 @@ class Scheduler:
         #: documents, and a snapshotter only understands ONE journal)
         self._snapshotter = None
         self._snapshotter_cluster = None
+        #: kai-pulse: gang name → pending age in cycles (host-owned so
+        #: the counters survive snapshot reindexing; weakref-scoped to
+        #: one cluster document like the fit shadow)
+        self._pending_age: dict[str, int] = {}
+        self._age_cluster = None
+        #: cycles this Scheduler has run — drives the analytics cadence
+        self._cycle_index = 0
+        #: gang labels currently carrying a nonzero starvation-age
+        #: gauge series — zeroed when they leave the top-K table, so a
+        #: placed gang never keeps reporting its last starving age
+        self._starv_gauge_gangs: set[str] = set()
+        #: last kai-pulse document, served by GET /debug/cluster.
+        #: Swapped whole (never mutated after publication) so handler
+        #: threads read it without the server's state lock.
+        #: (atomic-swap discipline: handler threads read the current
+        #: binding; the cycle thread swaps in a fresh immutable dict)
+        self._last_analytics: dict = {}
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
@@ -448,6 +480,22 @@ class Scheduler:
                     result.action_seconds[name] = time.perf_counter() - ta
                     metrics.action_latency.observe(
                         name, value=result.action_seconds[name])
+            # kai-pulse: dispatch the cluster-health kernel over the
+            # final commit set (ops/analytics.py) — async like the
+            # actions above, so its device time overlaps and lands in
+            # device_wait; the bundle rides the packed commit transfer.
+            bundle = None
+            every = self.config.analytics_every
+            run_analytics = every > 0 and self._cycle_index % every == 0
+            self._cycle_index += 1
+            if run_analytics:
+                ta = time.perf_counter()
+                with self.tracer.span("analytics"):
+                    ages = self._pending_age_vector(cluster, session)
+                    bundle = cluster_analytics_jit(
+                        session.state, result.tensors, ages,
+                        config=session.config.analytics)
+                result.analytics_seconds = time.perf_counter() - ta
         t_solve = time.perf_counter()
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
@@ -456,7 +504,7 @@ class Scheduler:
         # device-sync marker (dispatches above were async, so this wait
         # is link + device time, not host work).
         with self.tracer.span("device_wait", device_sync=True):
-            host = session.gather_host(result.tensors)
+            host = session.gather_host(result.tensors, analytics=bundle)
         t_gather = time.perf_counter()
         with self.tracer.span("host_decode"):
             result.bind_requests = session.bind_requests_from(
@@ -494,9 +542,28 @@ class Scheduler:
             events, dropped, counts = session.decision_events(
                 result.tensors, host=host, evictions=result.evictions,
                 limit=self.decisions.max_events_per_cycle)
+            # kai-pulse starvation: advance the per-gang pending-age
+            # counters and fire `starved` events for gangs crossing the
+            # alarm threshold this cycle (crossings counted EXACTLY;
+            # only event construction is bounded)
+            starved, crossings = self._advance_starvation(
+                cluster, session, host)
+            if crossings:
+                counts[gang_events.OUTCOME_STARVED] = crossings
+                room = max(0, self.decisions.max_events_per_cycle
+                           - len(events))
+                events = events + starved[:room]
             self.decisions.record_cycle(trace.cycle_id, events,
                                         dropped=dropped, counts=counts)
             self._record_metrics(session, result, host)
+            if bundle is not None:
+                result.analytics = session.analytics_doc(
+                    host,
+                    alarm_cycles=self.config.starvation_alarm_cycles)
+                self._record_analytics(session, host)
+                # atomic swap: published doc is never mutated, so
+                # /debug/cluster reads it without the server state lock
+                self._last_analytics = result.analytics
             # kai-wire: close this cycle's transfer window.  The
             # summary rides the result (healthz/bench) and the trace as
             # Chrome counter lanes — bytes-on-wire and live-bytes step
@@ -579,6 +646,136 @@ class Scheduler:
                     gauge.set(qnames[qi], RESOURCE_NAMES[ri],
                               value=float(table[qi, ri]))
             prev[key] = (qnames, table.copy())
+
+    @property
+    def last_analytics(self) -> dict:
+        """The most recent kai-pulse cluster-health document (empty
+        before the first analytics cycle) — the ``GET /debug/cluster``
+        payload.  Atomic-swap discipline: published docs are immutable."""
+        return self._last_analytics
+
+    def _scope_ages(self, cluster: Cluster) -> None:
+        """Reset the pending-age counters when the Scheduler is pointed
+        at a different cluster document (the HTTP server reuses one
+        Scheduler across documents — same discipline as the fit
+        shadow)."""
+        if (self._age_cluster is None
+                or self._age_cluster() is not cluster):
+            self._pending_age.clear()
+            self._age_cluster = weakref.ref(cluster)
+
+    def _pending_age_vector(self, cluster: Cluster,
+                            session: Session) -> "np.ndarray":
+        """f32 [G] — each gang slot's pending age BEFORE this cycle,
+        aligned to the current snapshot (the host owns the name-keyed
+        counters; the analytics kernel advances them on device for the
+        top-K table, and ``_advance_starvation`` advances the host copy
+        identically after decode)."""
+        self._scope_ages(cluster)
+        ages = np.zeros((session.state.gangs.g,), np.float32)
+        if self._pending_age:
+            names = session.index.gang_names
+            valid = session.index.host_tables["gang_valid"]
+            for gi in np.nonzero(valid[:len(names)])[0].tolist():
+                a = self._pending_age.get(names[gi])
+                if a:
+                    ages[gi] = a
+        return ages
+
+    #: per-cycle bound on starved-event construction (the alarm fires
+    #: once per gang at the crossing, so bursts only happen when many
+    #: gangs starve in lockstep)
+    MAX_STARVED_EVENTS = 64
+
+    def _advance_starvation(self, cluster: Cluster, session: Session,
+                            host: dict) -> tuple[list, int]:
+        """Advance the per-gang pending-age counters from this cycle's
+        outcome (+1 for still-pending gangs, reset on placement/exit)
+        and return ``(events, crossings)``: bounded ``starved``
+        GangDecision events for gangs whose age crossed
+        ``starvation_alarm_cycles`` exactly this cycle, plus the EXACT
+        crossing count (event construction is capped, the count never
+        is — the DecisionLog summary invariant)."""
+        alarm = self.config.starvation_alarm_cycles
+        if alarm <= 0 and self.config.analytics_every <= 0:
+            # feature fully off: no alarm to fire and no analytics
+            # kernel consuming the ages — skip the O(pending) walk
+            return [], 0
+        self._scope_ages(cluster)
+        names = session.index.gang_names
+        valid = host["gang_valid"][:len(names)]
+        alloc = host["allocated"][:len(names)]
+        reasons = host["fit_reason"]
+        old = self._pending_age
+        new: dict[str, int] = {}
+        starved: list = []
+        crossings = 0
+        qnames = session.index.queue_names
+        queues_of = None
+        for gi in np.nonzero(valid & ~alloc)[0].tolist():
+            name = names[gi]
+            age = old.get(name, 0) + 1
+            new[name] = age
+            if alarm > 0 and age == alarm:
+                crossings += 1
+                if len(starved) < self.MAX_STARVED_EVENTS:
+                    code = int(reasons[gi])
+                    if queues_of is None:
+                        queues_of = np.asarray(
+                            session.state.gangs.queue)
+                    qi = int(queues_of[gi])
+                    starved.append(gang_events.GangDecision(
+                        gang=name,
+                        queue=(qnames[qi]
+                               if 0 <= qi < len(qnames) else ""),
+                        outcome=gang_events.OUTCOME_STARVED,
+                        detail=(f"pending {age} cycles; blocker: "
+                                + FIT_REASONS.get(code,
+                                                  f"code {code}"))))
+        # rebuilt each cycle: placed/vanished gangs fall out (the reset
+        # path) and the dict never outgrows the live pending set
+        self._pending_age = new
+        return starved, crossings
+
+    def _record_analytics(self, session: Session, host: dict) -> None:
+        """kai_cluster_* / kai_gang_* gauge updates from the analytics
+        bundle that rode this cycle's packed commit."""
+        from . import metrics
+        from ..apis.types import RESOURCE_NAMES
+        a = host["analytics"]
+        metrics.cluster_fragmentation_score.set(
+            value=float(a["frag_score"]))
+        metrics.cluster_largest_rack_gang.set(
+            value=float(a["max_rack_units"]))
+        metrics.cluster_free_unit_pods.set(value=float(a["total_units"]))
+        metrics.cluster_goodput.set(value=float(a["goodput"]))
+        metrics.cluster_fairness_drift_max.set(
+            value=float(a["drift_max"]))
+        metrics.cluster_fairness_drift_gini.set(
+            value=float(a["drift_gini"]))
+        metrics.cluster_pending_gangs.set(
+            value=float(a["pending_gangs"]))
+        for r, rn in enumerate(RESOURCE_NAMES):
+            metrics.cluster_stranded_free_frac.set(
+                rn, value=float(a["stranded_frac"][r]))
+            metrics.cluster_utilization.set(rn, value=float(a["util"][r]))
+        drift = a["queue_drift"]
+        for qi, qn in enumerate(session.index.queue_names):
+            metrics.cluster_fairness_drift.set(
+                qn, value=float(drift[qi]))
+        gnames = session.index.gang_names
+        current: set[str] = set()
+        for age, gi in zip(a["starv_age"].tolist(),
+                           a["starv_gang"].tolist()):
+            if age > 0 and 0 <= gi < len(gnames):
+                metrics.gang_starvation_age.set(
+                    gnames[gi], value=float(age))
+                current.add(gnames[gi])
+        # a gang that placed (or fell out of the top-K) must stop
+        # reporting its last starving age — zero its stale series
+        for name in self._starv_gauge_gangs - current:
+            metrics.gang_starvation_age.set(name, value=0.0)
+        self._starv_gauge_gangs = current
 
     def _record_fit_status(self, cluster: Cluster, session: Session,
                            result: CycleResult, host: dict) -> None:

@@ -26,6 +26,13 @@ Reference surfaces collapse into one stdlib HTTP server:
   per-cycle, per-leaf host→device upload events with redundancy
   accounting, the device-residency gauge, and per-entry jit cache-miss
   attribution (``?cycles=`` bounds the ring window).
+- ``GET /debug/cluster`` — the kai-pulse cluster-health document
+  (``ops/analytics.py``): fragmentation (gang ladder, stranded
+  capacity, free histograms), utilization/goodput, fairness drift, and
+  the starvation top-K table of the latest analytics cycle.
+- ``GET /debug``        — machine-readable index of every debug
+  surface with one-line descriptions and live query params, so
+  operators stop grepping this file.
 
 The server is deliberately dependency-free (http.server); a production
 deployment would front it with gRPC — the payloads are already the
@@ -49,6 +56,35 @@ from ..runtime.snapshot import dump_cluster, load_cluster
 from . import metrics
 from .scheduler import Scheduler
 from .session import Session
+
+
+#: every debug surface the server mounts, with live query params — the
+#: ``GET /debug`` index payload (an endpoint test pins this list
+#: against the actual routes, so it cannot rot)
+DEBUG_SURFACES = (
+    {"path": "/debug", "params": (),
+     "desc": "this index: every debug surface with query params"},
+    {"path": "/debug/trace", "params": ("cycles",),
+     "desc": ("kai-trace flight recorder: retained cycles' "
+              "phase-attributed span trees as Chrome-trace JSON")},
+    {"path": "/debug/events", "params": ("gang",),
+     "desc": ("per-gang decision events: allocated / fit-failure / "
+              "quota-gate / preempted-for / starved")},
+    {"path": "/debug/wire", "params": ("cycles",),
+     "desc": ("kai-wire transfer ledger + compile watcher: per-leaf "
+              "uploads, redundancy accounting, device residency, "
+              "per-entry jit cache misses")},
+    {"path": "/debug/cluster", "params": (),
+     "desc": ("kai-pulse cluster health: fragmentation gang ladder + "
+              "stranded capacity, utilization/goodput, fairness "
+              "drift, starvation top-K (latest analytics cycle)")},
+    {"path": "/debug/pprof", "params": (),
+     "desc": ("one profiled cycle (cProfile): hottest host functions "
+              "+ kai-trace phase breakdown")},
+    {"path": "/debug/pprof/continuous", "params": (),
+     "desc": ("continuous-profiler folded-stack windows (404 while "
+              "the sampler is off)")},
+)
 
 
 def job_order(cluster: Cluster, scheduler: Scheduler) -> list[dict]:
@@ -327,6 +363,34 @@ class SchedulerServer:
                     doc = wire_ledger.LEDGER.wire_doc(cycles=cycles)
                     doc["compile"] = compile_watch.WATCHER.report()
                     self._send(doc)
+                elif self.path.startswith("/debug/cluster"):
+                    # kai-pulse cluster-health document: the LAST
+                    # analytics cycle's immutable doc.  Only the
+                    # scheduler handle is read under the state lock;
+                    # the doc itself is atomic-swapped by the cycle
+                    # thread and never mutated after publication, so
+                    # this can never tear and never stalls a cycle.
+                    with outer._state_lock:
+                        sched = outer.scheduler
+                    doc = sched.last_analytics
+                    self._send({
+                        "analytics": doc,
+                        "analytics_every":
+                            sched.config.analytics_every,
+                        "starvation_alarm_cycles":
+                            sched.config.starvation_alarm_cycles,
+                        "ok": bool(doc)})
+                elif self.path in ("/debug", "/debug/"):
+                    # index of every debug surface — static doc plus
+                    # which optional surfaces are live right now
+                    surfaces = [dict(s, params=list(s["params"]))
+                                for s in DEBUG_SURFACES]
+                    for s in surfaces:
+                        if s["path"] == "/debug/pprof/continuous":
+                            s["live"] = outer.profiler is not None
+                        else:
+                            s["live"] = True
+                    self._send({"surfaces": surfaces})
                 elif self.path.startswith("/debug/pprof/continuous"):
                     # the continuous-profiling (Pyroscope) analogue:
                     # retained folded-stack windows (profiler state is
@@ -467,6 +531,27 @@ class SchedulerServer:
                 # kai-wire summary of the cycle: bytes on the wire by
                 # reason, redundant re-uploads, device residency
                 wire=dict(result.wire))
+            # kai-pulse slice: the headline cluster-health gauges of
+            # the latest analytics cycle (this one, or — on cycles the
+            # cadence skipped — the last one that ran)
+            pulse = (result.analytics
+                     or self.scheduler.last_analytics)
+            if pulse:
+                stats["cluster"] = {
+                    "fragmentation_score":
+                        pulse["fragmentation"]["score"],
+                    "largest_rack_unit_pods":
+                        pulse["fragmentation"]["largest_rack_unit_pods"],
+                    "goodput": pulse["goodput"],
+                    "utilization": dict(pulse["utilization"]),
+                    "fairness_drift_max":
+                        pulse["fairness"]["drift_max"],
+                    "pending_gangs":
+                        pulse["starvation"]["pending_gangs"],
+                    "oldest_pending_age_cycles": max(
+                        [o["age_cycles"] for o
+                         in pulse["starvation"]["oldest"]], default=0),
+                }
         self._cycle_stats = stats
 
     def start(self) -> "SchedulerServer":

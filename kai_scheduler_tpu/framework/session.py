@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..apis import types as apis
+from ..ops import analytics as pulse
 from ..ops import drf
 from ..runtime import compile_watch
 from ..runtime import events as gang_events
@@ -66,9 +67,11 @@ def _bitunpack(p: "np.ndarray", k: int) -> "np.ndarray":
             .astype(bool).reshape(-1)[:k])
 
 
-@functools.partial(jax.jit, static_argnames=("track_devices",))
+@functools.partial(jax.jit, static_argnames=("track_devices",
+                                              "track_analytics"))
 def _pack_commit(result: AllocationResult, state: ClusterState,
-                 *, track_devices: bool) -> jax.Array:
+                 *, track_devices: bool, track_analytics: bool = False,
+                 analytics=None) -> jax.Array:
     q = state.queues
     parts = [
         (result.placements + 1).ravel().astype(jnp.int16),
@@ -87,6 +90,15 @@ def _pack_commit(result: AllocationResult, state: ClusterState,
     if track_devices:
         parts.append(
             (result.placement_device + 1).ravel().astype(jnp.int16))
+    if track_analytics:
+        # kai-pulse: the cluster-health bundle rides the SAME packed
+        # transfer (ops/analytics.py) — zero extra dispatches or bytes
+        # beyond its own payload
+        a32, ai = pulse.flatten(analytics)
+        parts.append(
+            jax.lax.bitcast_convert_type(a32, jnp.int16).ravel())
+        parts.append(
+            jax.lax.bitcast_convert_type(ai, jnp.int16).ravel())
     return jnp.concatenate(parts)
 
 
@@ -159,6 +171,10 @@ class SessionConfig:
 
     allocate: AllocateConfig = dataclasses.field(default_factory=AllocateConfig)
     victims: VictimConfig = dataclasses.field(default_factory=VictimConfig)
+    #: kai-pulse cluster-health kernel knobs (ops/analytics.py); the
+    #: cadence itself is a Scheduler-level knob (analytics_every)
+    analytics: pulse.AnalyticsConfig = dataclasses.field(
+        default_factory=pulse.AnalyticsConfig)
     #: derive kernel fast-path flags (track_devices / uniform_tasks) from
     #: the snapshot shape at session open — a snapshot with no fractional
     #: requests skips the per-device bookkeeping, and one whose gangs are
@@ -270,10 +286,14 @@ class Session:
 
     # -- commit path ------------------------------------------------------
 
-    def gather_host(self, result: AllocationResult) -> dict:
+    def gather_host(self, result: AllocationResult,
+                    analytics=None) -> dict:
         """ONE compact device→host transfer of the cycle's results,
         merged with the snapshot-side numpy tables the host never let go
-        of (see ``_pack_commit``)."""
+        of (see ``_pack_commit``).  ``analytics`` (an
+        ``ops.analytics.AnalyticsBundle``, optional) rides the same
+        packed array — the kai-pulse bundle never costs a second
+        transfer."""
         g, q, r = self.state.gangs, self.state.queues, self.state.running
         G, T, M, Q = g.g, g.t, r.m, q.q
         R_ = self.state.nodes.free.shape[1]
@@ -283,7 +303,10 @@ class Session:
             raise ValueError("i16 commit packing needs < 32k nodes")
         devices = self.index.needs_device_table
         flat = np.asarray(_pack_commit(result, self.state,
-                                       track_devices=devices))
+                                       track_devices=devices,
+                                       track_analytics=analytics
+                                       is not None,
+                                       analytics=analytics))
 
         def take(n):
             nonlocal off
@@ -316,6 +339,14 @@ class Session:
                                        ).reshape(G, T)
         else:
             out["placement_device"] = np.full((G, T), -1, np.int32)
+        if analytics is not None:
+            acfg = self.config.analytics
+            nf = pulse.f32_len(acfg, q=Q, r=R_, g=G)
+            ni = pulse.i32_len(acfg, q=Q, r=R_, g=G)
+            a32 = np.frombuffer(take(nf * 2).tobytes(), np.float32)
+            ai = np.frombuffer(take(ni * 2).tobytes(), np.int32)
+            out["analytics"] = pulse.host_unpack(
+                a32, ai, config=acfg, q=Q, r=R_, g=G)
         return out
 
     def bind_requests_from(self, result: AllocationResult,
@@ -439,6 +470,76 @@ class Session:
             out[self.index.gang_names[gi]] = FIT_REASONS.get(
                 int(reasons[gi]), f"code {int(reasons[gi])}")
         return out
+
+    def analytics_doc(self, host: dict, *,
+                      alarm_cycles: int = 0) -> dict:
+        """The kai-pulse bundle as a JSON-able cluster-health document —
+        the ``GET /debug/cluster`` payload and ``CycleResult.analytics``.
+        Names come from the SnapshotIndex; array data from the bundle
+        that rode this cycle's packed commit transfer (``host``)."""
+        a = host.get("analytics")
+        if a is None:
+            return {}
+        from ..apis.types import RESOURCE_NAMES
+        acfg = self.config.analytics
+        qnames = self.index.queue_names
+        gnames = self.index.gang_names
+        reasons = host["fit_reason"]
+        queues_of = np.asarray(self.state.gangs.queue)
+        drift = a["queue_drift"][:len(qnames)]
+        top_q = np.argsort(-drift)[:5]
+        oldest = []
+        for age, gi in zip(a["starv_age"].tolist(),
+                           a["starv_gang"].tolist()):
+            if age <= 0 or not 0 <= gi < len(gnames):
+                continue
+            qi = int(queues_of[gi])
+            code = int(reasons[gi])
+            oldest.append({
+                "gang": gnames[gi],
+                "queue": qnames[qi] if 0 <= qi < len(qnames) else "",
+                "age_cycles": int(age),
+                "blocker": FIT_REASONS.get(code, f"code {code}")
+                if code else "",
+            })
+        return {
+            "fragmentation": {
+                "score": round(float(a["frag_score"]), 4),
+                "total_unit_pods": float(a["total_units"]),
+                "largest_rack_unit_pods": float(a["max_rack_units"]),
+                "unit_req": list(acfg.unit_req),
+                "stranded_free_frac": {
+                    RESOURCE_NAMES[r]: round(float(v), 4)
+                    for r, v in enumerate(a["stranded_frac"].tolist())},
+                "free_hist": {
+                    RESOURCE_NAMES[r]: [int(c) for c in row]
+                    for r, row in enumerate(a["free_hist"].tolist())},
+                "gang_ladder": [
+                    {"pods": int(p), "cluster_feasible": bool(c > 0),
+                     "rack_placeable": bool(k > 0)}
+                    for p, c, k in zip(acfg.gang_ladder,
+                                       a["ladder_cluster_ok"].tolist(),
+                                       a["ladder_rack_ok"].tolist())],
+            },
+            "utilization": {
+                RESOURCE_NAMES[r]: round(float(v), 4)
+                for r, v in enumerate(a["util"].tolist())},
+            "goodput": round(float(a["goodput"]), 4),
+            "fairness": {
+                "drift_max": round(float(a["drift_max"]), 4),
+                "drift_mean": round(float(a["drift_mean"]), 4),
+                "drift_gini": round(float(a["drift_gini"]), 4),
+                "top_drift": [
+                    {"queue": qnames[int(qi)],
+                     "drift": round(float(drift[int(qi)]), 4)}
+                    for qi in top_q if drift[int(qi)] > 0],
+            },
+            "starvation": {
+                "pending_gangs": int(a["pending_gangs"]),
+                "alarm_cycles": int(alarm_cycles),
+                "oldest": oldest,
+            },
+        }
 
     #: per-cycle caps on decision-event CONSTRUCTION (the commit path
     #: must not spend milliseconds building event objects; exact

@@ -1,0 +1,356 @@
+"""kai-pulse — on-device cluster-health analytics.
+
+The runtime is observable (kai-trace phase spans, the kai-wire transfer
+ledger) but the *cluster state* the solver works on was a black box:
+nothing reported how fragmented free capacity is, how far actual
+allocation drifts from the DRF fair-share target, or how long gangs
+starve.  This kernel runs over the device-resident snapshot each cycle
+(or every K cycles — ``SchedulerConfig.analytics_every``) and emits one
+compact fixed-shape stats bundle that rides the packed commit transfer:
+no extra host↔device round trip, zero bytes added to the wire ledger
+(the kernel consumes state already on device; its only host input is
+the tiny pending-age vector that rides the jit dispatch).
+
+Four gauge families:
+
+* **fragmentation** — per-node free-fraction histograms per resource, a
+  largest-placeable-gang probe over a ladder of canonical gang sizes
+  (reusing the allocate action's ``resource_fit_mask`` predicate for
+  the unit-pod fit), and a rack-level stranded-capacity score: the
+  fraction of ladder rungs the cluster could serve by raw free units
+  but NO single rack domain can host.  This is the gauge ROADMAP item 5
+  gates the repack solver behind ("Priority Matters", arxiv 2511.08373,
+  treats fragmentation as the signal that triggers constraint-based
+  repacking) — it reads high exactly while a rack-required large gang
+  is unplaceable and drops once capacity consolidates.
+* **goodput / utilization** — allocated-vs-capacity per resource axis,
+  plus cluster goodput in Gavel's effective-throughput sense (arxiv
+  2008.09213): sum of per-accelerator throughputs of work that is
+  running (or bound this cycle) over cluster accel capacity.  Unit
+  throughput per device today; the ROADMAP item-4 per-(job, accel-type)
+  throughput tensors slot into ``_goodput`` without changing the bundle
+  shape.
+* **fairness drift** — per-queue ``max_r |allocated − fair_share| /
+  capacity`` deviation from the DRF division (``ops/drf.py``), with
+  max / mean / Gini rollups over the dominant allocated shares.
+* **starvation** — per-gang pending age in cycles (host-fed, the
+  scheduler owns the name-keyed counters across snapshot reindexing)
+  with an on-device top-K oldest table.
+
+Everything is f32/i32 fixed-shape tensor math: the op is registered in
+the jaxpr probe (``analysis/trace_probe.py``) with its own eqn/const
+baselines, wrapped by the CompileWatcher, and lives in the kai-lint jit
+region like every other cycle kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..runtime import compile_watch
+from ..state.cluster_state import ClusterState
+from .allocate import AllocationResult
+from .predicates import resource_fit_mask
+
+EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyticsConfig:
+    """Static knobs of the cluster-health kernel (hashable — rides the
+    jit signature like ``AllocateConfig``)."""
+
+    #: free-fraction histogram bins per resource axis
+    hist_bins: int = 8
+    #: canonical gang sizes (unit pods) for the largest-placeable probe;
+    #: the top rung matches ROADMAP item 5's 256-pod repack scenario
+    gang_ladder: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+    #: the canonical unit-pod request (accel, cpu, mem) the ladder and
+    #: stranded-capacity gauges probe with; accel-only by default so
+    #: the gauge reads as "whole idle devices"
+    unit_req: tuple[float, float, float] = (1.0, 0.0, 0.0)
+    #: topology level index treated as the rack for the stranded-
+    #: capacity probe (0 = outermost; clamped to the snapshot's level
+    #: count; topology-free snapshots degrade to per-node domains)
+    rack_level: int = 0
+    #: starvation table size (oldest pending gangs)
+    top_k: int = 8
+
+
+class AnalyticsBundle(struct.PyTreeNode):
+    """The fixed-shape stats bundle one analytics pass emits.
+
+    Rides the packed commit transfer (``framework/session._pack_commit``
+    appends the flattened bundle), so surfacing it costs zero extra
+    device→host transfers.
+    """
+
+    free_hist: jax.Array          # f32 [R, BINS]  valid-node counts
+    ladder_cluster_ok: jax.Array  # f32 [LAD] 1 = total free units cover rung
+    ladder_rack_ok: jax.Array     # f32 [LAD] 1 = some rack covers rung alone
+    total_units: jax.Array        # f32 []  placeable unit pods cluster-wide
+    max_rack_units: jax.Array     # f32 []  placeable unit pods, best rack
+    stranded_frac: jax.Array      # f32 [R] free stuck on nodes unfit for 1 unit
+    frag_score: jax.Array         # f32 []  rack-stranded rungs / feasible rungs
+    util: jax.Array               # f32 [R] allocated / capacity
+    goodput: jax.Array            # f32 []  effective throughput / accel capacity
+    queue_drift: jax.Array        # f32 [Q] max_r |alloc - fair| / cap_r
+    drift_max: jax.Array          # f32 []
+    drift_mean: jax.Array         # f32 []  over valid queues
+    drift_gini: jax.Array         # f32 []  over dominant allocated shares
+    starv_age: jax.Array          # f32 [K] top-K pending ages (cycles)
+    starv_gang: jax.Array         # i32 [K] gang index per table row
+    pending_gangs: jax.Array      # f32 []  gangs still pending after the cycle
+
+
+#: bundle fields in flatten/unpack order — f32 parts then i32 parts;
+#: shapes derived from (config, Q, R) by :func:`field_shapes`
+F32_FIELDS = (
+    "free_hist", "ladder_cluster_ok", "ladder_rack_ok", "total_units",
+    "max_rack_units", "stranded_frac", "frag_score", "util", "goodput",
+    "queue_drift", "drift_max", "drift_mean", "drift_gini", "starv_age",
+    "pending_gangs")
+I32_FIELDS = ("starv_gang",)
+
+
+def field_shapes(config: AnalyticsConfig, *, q: int, r: int,
+                 g: int) -> dict:
+    """Field name → shape for a (Q, R, G)-shaped snapshot — the single
+    source of truth keeping :func:`flatten` and :func:`host_unpack` in
+    lockstep."""
+    lad = len(config.gang_ladder)
+    k = min(config.top_k, max(g, 1))
+    return {
+        "free_hist": (r, config.hist_bins),
+        "ladder_cluster_ok": (lad,), "ladder_rack_ok": (lad,),
+        "total_units": (), "max_rack_units": (),
+        "stranded_frac": (r,), "frag_score": (),
+        "util": (r,), "goodput": (),
+        "queue_drift": (q,), "drift_max": (), "drift_mean": (),
+        "drift_gini": (),
+        "starv_age": (k,),
+        "pending_gangs": (),
+        "starv_gang": (k,),
+    }
+
+
+def _shape_len(shape: tuple) -> int:
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def f32_len(config: AnalyticsConfig, *, q: int, r: int, g: int) -> int:
+    shapes = field_shapes(config, q=q, r=r, g=g)
+    return sum(_shape_len(shapes[f]) for f in F32_FIELDS)
+
+
+def i32_len(config: AnalyticsConfig, *, q: int, r: int, g: int) -> int:
+    shapes = field_shapes(config, q=q, r=r, g=g)
+    return sum(_shape_len(shapes[f]) for f in I32_FIELDS)
+
+
+def flatten(bundle: AnalyticsBundle) -> tuple[jax.Array, jax.Array]:
+    """Bundle → (flat f32, flat i32) in the canonical field order —
+    traced inside ``_pack_commit`` so the bundle rides the ONE packed
+    commit transfer."""
+    f32 = jnp.concatenate(
+        [getattr(bundle, f).reshape(-1).astype(jnp.float32)
+         for f in F32_FIELDS])
+    i32 = jnp.concatenate(
+        [getattr(bundle, f).reshape(-1).astype(jnp.int32)
+         for f in I32_FIELDS])
+    return f32, i32
+
+
+def host_unpack(flat_f32, flat_i32, *, config: AnalyticsConfig,
+                q: int, r: int, g: int) -> dict:
+    """Flat host copies → field name → numpy array (gather_host side)."""
+    shapes = field_shapes(config, q=q, r=r, g=g)
+    out = {}
+    off = 0
+    for f in F32_FIELDS:
+        n = _shape_len(shapes[f])
+        out[f] = flat_f32[off:off + n].reshape(shapes[f])
+        off += n
+    off = 0
+    for f in I32_FIELDS:
+        n = _shape_len(shapes[f])
+        out[f] = flat_i32[off:off + n].reshape(shapes[f])
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _free_hist_one(frac: jax.Array, valid: jax.Array,
+                   bins: int) -> jax.Array:
+    """Histogram of one resource's free fraction over valid nodes —
+    vmapped over the resource axis."""
+    idx = jnp.clip(jnp.floor(frac * bins).astype(jnp.int32), 0, bins - 1)
+    idx = jnp.where(valid, idx, bins)  # invalid nodes → junk bin
+    return jax.ops.segment_sum(
+        jnp.ones_like(frac), idx, num_segments=bins + 1)[:bins]
+
+
+def _unit_pods_per_node(free: jax.Array, valid: jax.Array,
+                        unit: jax.Array) -> jax.Array:
+    """f32 [N] — canonical unit pods each node can host, gated on the
+    allocate fit predicate (``resource_fit_mask``) so the probe agrees
+    with what the placement kernel would accept."""
+    fits_one = resource_fit_mask(free, unit)                # [N]
+    per_axis = jnp.where(unit[None, :] > 0,
+                         jnp.floor(free / jnp.maximum(unit, EPS)[None, :]),
+                         jnp.inf)
+    units = jnp.min(per_axis, axis=1)
+    units = jnp.where(jnp.isfinite(units), units, 0.0)
+    return jnp.where(valid & fits_one, jnp.maximum(units, 0.0), 0.0)
+
+
+def _rack_units(state: ClusterState, units: jax.Array,
+                rack_level: int) -> jax.Array:
+    """f32 [] — unit pods placeable inside the single best rack domain.
+    Nodes without the rack label (or topology-free snapshots) count as
+    their own one-node domain — the degenerate per-node reading."""
+    n = state.nodes
+    N, L = n.n, n.topology.shape[1]
+    rl = min(max(rack_level, 0), L - 1)
+    dom = n.topology[:, rl]
+    node_slot = N * L + jnp.arange(N)
+    junk = N * L + N
+    seg = jnp.where(n.valid, jnp.where(dom >= 0, dom, node_slot), junk)
+    per_dom = jax.ops.segment_sum(units, seg, num_segments=junk + 1)
+    return jnp.max(per_dom.at[junk].set(0.0))
+
+
+def _gini(shares: jax.Array, valid: jax.Array) -> jax.Array:
+    """Gini coefficient of ``shares`` over valid queues (0 when fewer
+    than two live queues or no allocation)."""
+    s = jnp.where(valid, shares, 0.0)
+    n = jnp.sum(valid.astype(jnp.float32))
+    pair = jnp.abs(s[:, None] - s[None, :]) \
+        * (valid[:, None] & valid[None, :])
+    total = jnp.sum(s)
+    return jnp.where((n > 1) & (total > 0),
+                     jnp.sum(pair) / jnp.maximum(2.0 * n * total, EPS),
+                     0.0)
+
+
+def cluster_analytics(state: ClusterState, result: AllocationResult,
+                      pending_age: jax.Array, *,
+                      config: AnalyticsConfig) -> AnalyticsBundle:
+    """One analytics pass over the POST-decision cluster state.
+
+    The **fragmentation** family reads the PRE-decision snapshot free
+    pool (``state.nodes.free``): it describes the capacity shape the
+    cycle's decisions — and a future repack solver — act ON, so the
+    gauge drops the moment capacity consolidates, in the same cycle the
+    stranded gang finally places (the predictive property the frag
+    scenario test pins).  The **outcome** families (utilization,
+    goodput, fairness drift, starvation) read the cycle's final commit
+    set: ``result.free`` is the idle pool after commits,
+    ``result.queue_allocated`` the post-commit queue ledger,
+    ``result.allocated`` the gangs that made it.  ``pending_age``
+    (f32 [G]) is the host-owned pending-cycles counter per gang slot
+    BEFORE this cycle; the kernel advances it for gangs that stayed
+    pending (+1) and zeroes gangs that placed, so the top-K table
+    reflects end-of-cycle ages.
+    """
+    nodes, queues, gangs = state.nodes, state.queues, state.gangs
+    R = nodes.free.shape[1]
+
+    # --- fragmentation (pre-decision capacity shape) ----------------------
+    free = jnp.maximum(nodes.free, 0.0)
+    alloc_cap = nodes.allocatable
+    frac = jnp.where(alloc_cap > 0, free / jnp.maximum(alloc_cap, EPS), 0.0)
+    free_hist = jax.vmap(_free_hist_one, in_axes=(1, None, None),
+                         out_axes=0)(frac, nodes.valid, config.hist_bins)
+    unit = jnp.asarray(config.unit_req, jnp.float32)
+    units = _unit_pods_per_node(free, nodes.valid, unit)
+    total_units = jnp.sum(units)
+    max_rack_units = _rack_units(state, units, config.rack_level)
+    ladder = jnp.asarray(config.gang_ladder, jnp.float32)
+    ladder_cluster_ok = (total_units >= ladder).astype(jnp.float32)
+    ladder_rack_ok = (max_rack_units >= ladder).astype(jnp.float32)
+    # rungs the cluster could serve by raw free units but no single rack
+    # can host — the stranded-rung fraction IS the fragmentation score
+    stranded_rungs = ladder_cluster_ok * (1.0 - ladder_rack_ok)
+    frag_score = jnp.sum(stranded_rungs) / jnp.maximum(
+        jnp.sum(ladder_cluster_ok), 1.0)
+    free_valid = jnp.where(nodes.valid[:, None], free, 0.0)
+    stuck = jnp.where((units <= 0)[:, None], free_valid, 0.0)
+    free_tot = jnp.sum(free_valid, axis=0)
+    stranded_frac = jnp.where(free_tot > 0,
+                              jnp.sum(stuck, axis=0)
+                              / jnp.maximum(free_tot, EPS), 0.0)
+
+    # --- goodput / utilization (post-decision) ---------------------------
+    cap = jnp.sum(jnp.where(nodes.valid[:, None], alloc_cap, 0.0), axis=0)
+    post_free = jnp.where(nodes.valid[:, None],
+                          jnp.maximum(result.free, 0.0), 0.0)
+    releasing = jnp.where(nodes.valid[:, None],
+                          nodes.releasing + result.releasing_extra, 0.0)
+    idle = post_free + jnp.maximum(releasing, 0.0)
+    util = jnp.where(cap > 0,
+                     1.0 - jnp.sum(idle, axis=0) / jnp.maximum(cap, EPS),
+                     0.0)
+    # Gavel effective throughput, unit throughput per accel device:
+    # running survivors keep contributing, this cycle's victims stop,
+    # and this cycle's non-pipelined placements start.  The item-4
+    # throughput tensors replace the two `* 1.0` unit factors.
+    run = state.running
+    surviving = run.valid & ~run.releasing & ~result.victim
+    thr_running = jnp.sum(
+        jnp.where(surviving, run.req[:, 0], 0.0) * 1.0)
+    placed = (result.placements >= 0) & gangs.task_valid \
+        & result.allocated[:, None] & ~result.pipelined
+    thr_placed = jnp.sum(
+        jnp.where(placed, gangs.task_req[:, :, 0], 0.0) * 1.0)
+    goodput = (thr_running + thr_placed) / jnp.maximum(cap[0], EPS)
+
+    # --- fairness drift ---------------------------------------------------
+    qvalid = queues.valid
+    dev = jnp.abs(result.queue_allocated - queues.fair_share) \
+        / jnp.maximum(cap, 1.0)[None, :]
+    queue_drift = jnp.where(qvalid, jnp.max(dev, axis=1), 0.0)
+    nq = jnp.sum(qvalid.astype(jnp.float32))
+    drift_max = jnp.max(queue_drift)
+    drift_mean = jnp.sum(queue_drift) / jnp.maximum(nq, 1.0)
+    dom_share = jnp.max(result.queue_allocated
+                        / jnp.maximum(cap, 1.0)[None, :], axis=1)
+    drift_gini = _gini(dom_share, qvalid)
+
+    # --- starvation -------------------------------------------------------
+    still_pending = gangs.valid & ~result.allocated
+    age_next = jnp.where(still_pending, pending_age + 1.0, 0.0)
+    k = min(config.top_k, age_next.shape[0])
+    starv_age, starv_gang = jax.lax.top_k(age_next, k)
+    pending_gangs = jnp.sum(still_pending.astype(jnp.float32))
+
+    return AnalyticsBundle(
+        free_hist=free_hist.astype(jnp.float32),
+        ladder_cluster_ok=ladder_cluster_ok,
+        ladder_rack_ok=ladder_rack_ok,
+        total_units=total_units, max_rack_units=max_rack_units,
+        stranded_frac=stranded_frac, frag_score=frag_score,
+        util=util, goodput=goodput,
+        queue_drift=queue_drift, drift_max=drift_max,
+        drift_mean=drift_mean, drift_gini=drift_gini,
+        starv_age=starv_age, starv_gang=starv_gang.astype(jnp.int32),
+        pending_gangs=pending_gangs)
+
+
+# kai-wire compile watcher: per-(entry, signature) cache-miss
+# attribution (runtime/compile_watch.py)
+cluster_analytics_jit = compile_watch.watch(
+    "analytics",
+    functools.partial(jax.jit,
+                      static_argnames=("config",))(cluster_analytics))

@@ -12,7 +12,11 @@ records its cycle outcome into a bounded per-cycle buffer:
   queue gates (fit-reason code 3);
 * ``preempted-for``  — the gang's running pods were evicted to free
   capacity for pending work (detail names the beneficiaries when the
-  commit pipelined onto the freed capacity).
+  commit pipelined onto the freed capacity);
+* ``starved``        — the gang's pending age crossed the configured
+  starvation alarm (``SchedulerConfig.starvation_alarm_cycles``);
+  detail carries the FIT_REASONS text of its current blocker
+  (kai-pulse, ``ops/analytics.py``).
 
 The log retains the last N cycles and is served by
 ``GET /debug/events?gang=<name>`` on the SchedulerServer; its last-cycle
@@ -32,12 +36,14 @@ import threading
 __all__ = [
     "GangDecision", "DecisionLog", "OUTCOME_ALLOCATED",
     "OUTCOME_FIT_FAILURE", "OUTCOME_QUOTA_GATE", "OUTCOME_PREEMPTED_FOR",
+    "OUTCOME_STARVED",
 ]
 
 OUTCOME_ALLOCATED = "allocated"
 OUTCOME_FIT_FAILURE = "fit-failure"
 OUTCOME_QUOTA_GATE = "quota-gate"
 OUTCOME_PREEMPTED_FOR = "preempted-for"
+OUTCOME_STARVED = "starved"
 
 
 @dataclasses.dataclass(frozen=True)

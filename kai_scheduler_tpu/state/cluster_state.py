@@ -1766,6 +1766,11 @@ def build_snapshot(
             "task_dra": gk["task_dra"],
             "running_gang": rk["gang"],
             "queue_usage": q_usage,
+            # gangs with pending tasks this snapshot — the SAME mask
+            # the analytics kernel reads as ``gangs.valid``, so the
+            # kai-pulse starvation counters advance in lockstep with
+            # the device-side top-K table
+            "gang_valid": gk["valid"],
         },
         dense_feasibility=(
             not selector_keys and len(filter_specs) == 1

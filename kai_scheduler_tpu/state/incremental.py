@@ -1338,6 +1338,10 @@ class IncrementalSnapshotter:
                 "task_dra": self._const["task_dra"],
                 "running_gang": rk["gang"],
                 "queue_usage": roll["q_usage"],
+                # the device-side gangs.valid mask (gangs with pending
+                # tasks), host copy — kai-pulse starvation counters
+                # advance against exactly what the kernel sees
+                "gang_valid": np.asarray(gangs.valid),
             },
             dense_feasibility=dense,
         )

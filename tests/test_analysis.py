@@ -176,6 +176,8 @@ def test_known_jit_entry_points_probed():
         "run_victim_action_jit": {"victims_reclaim", "victims_preempt",
                                   "victims_consolidate"},
         "cumsum_ds": {"cumsum_ds"},
+        # kai-pulse cluster-health kernel (ops/analytics.py)
+        "cluster_analytics": {"analytics"},
     }
     graph = PackageGraph(ROOT)
     entries = {q for _m, q in graph._entries()}

@@ -84,6 +84,52 @@ def test_victim_wavefront_gauges_populated():
         assert name in text
 
 
+def test_starvation_alarm_gauge_and_decision_event():
+    """PR-9 kai-pulse: a gang pending past ``starvation_alarm_cycles``
+    fires exactly one ``starved`` DecisionLog event carrying the
+    FIT_REASONS text of its blocker, and the top-K
+    ``kai_gang_starvation_age_cycles`` gauge tracks its pending age."""
+    from kai_scheduler_tpu.apis import types as apis
+    from kai_scheduler_tpu.framework import metrics
+    from kai_scheduler_tpu.framework.scheduler import (Scheduler,
+                                                       SchedulerConfig)
+    from kai_scheduler_tpu.framework.session import FIT_REASONS
+    from kai_scheduler_tpu.runtime import events as gang_events
+    from kai_scheduler_tpu.runtime.cluster import Cluster
+
+    nodes = [apis.Node("n0", apis.ResourceVec(8, 64, 256))]
+    queues = [apis.Queue("q", accel=apis.QueueResource(quota=8))]
+    groups = [apis.PodGroup("hungry", queue="q", min_member=1)]
+    # requests no node can ever satisfy — the gang starves forever
+    pods = [apis.Pod("p0", "hungry", apis.ResourceVec(64, 1, 1))]
+    cluster = Cluster.from_objects(nodes, queues, groups, pods)
+    sched = Scheduler(SchedulerConfig(starvation_alarm_cycles=2))
+    for _ in range(3):
+        res = sched.run_once(cluster)
+    assert res.bind_requests == []
+    # gauge: the top-K table carries the gang at its current age
+    assert metrics.gang_starvation_age.value("hungry") == 3.0
+    # the /debug/cluster starvation family agrees
+    starv = res.analytics["starvation"]
+    assert starv["oldest"][0]["gang"] == "hungry"
+    assert starv["oldest"][0]["age_cycles"] == 3
+    assert starv["oldest"][0]["blocker"] == FIT_REASONS[1]
+    # exactly ONE starved event, fired at the crossing, blocker text in
+    # the detail
+    evs = [e for e in sched.decisions.events(gang="hungry")
+           if e["outcome"] == gang_events.OUTCOME_STARVED]
+    assert len(evs) == 1
+    assert FIT_REASONS[1] in evs[0]["detail"]
+    assert "pending 2 cycles" in evs[0]["detail"]
+    # the starved outcome is counted in the cycle summary it fired in
+    assert any(
+        c[3].get(gang_events.OUTCOME_STARVED) == 1
+        for c in sched.decisions._cycles)
+    text = metrics.registry.render()
+    assert "kai_gang_starvation_age_cycles" in text
+    assert "kai_cluster_fragmentation_score" in text
+
+
 def test_infra_logger_verbosity_and_scope(capsys):
     log = InfraLogger(name="kai-test", verbosity=3)
     scoped = log.with_scope(session=7, action="allocate")

@@ -378,6 +378,8 @@ def test_compile_watcher_covers_callgraph_jit_entries():
         "set_fair_share": "set_fair_share",
         "stale_gang_eviction": "stale_gang_eviction",
         "run_victim_action_jit": "run_victim_action",
+        # kai-pulse cluster-health kernel (ops/analytics.py)
+        "cluster_analytics": "analytics",
         # analysis-only probe helper, never on the production cycle
         "cumsum_ds": None,
     }
