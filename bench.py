@@ -661,7 +661,7 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
 
 def _frag_cluster_10k(num_racks: int = 40, nodes_per_rack: int = 250,
                       node_accel: int = 8, fill: int = 7,
-                      gang_pods: int = 256):
+                      gang_pods: int = 256, preemptible: bool = False):
     """A fragmented 10k-node cluster (ROADMAP item 5's scenario,
     pre-staged): every node holds ``fill``/``node_accel`` devices of
     NON-preemptible fillers, so each rack strands ``nodes_per_rack``
@@ -683,7 +683,10 @@ def _frag_cluster_10k(num_racks: int = 40, nodes_per_rack: int = 250,
         g = apis.PodGroup(
             f"fill-{rack}", queue="fill",
             min_member=nodes_per_rack * fill,
-            preemptibility=apis.Preemptibility.NON_PREEMPTIBLE)
+            preemptibility=(apis.Preemptibility.PREEMPTIBLE
+                            if preemptible
+                            else apis.Preemptibility.NON_PREEMPTIBLE),
+            last_start_timestamp=0.0)
         groups.append(g)
         for j in range(nodes_per_rack):
             i = rack * nodes_per_rack + j
@@ -707,34 +710,48 @@ def _frag_cluster_10k(num_racks: int = 40, nodes_per_rack: int = 250,
     return Cluster.from_objects(nodes, queues, groups, pods, topo)
 
 
-def bench_frag(iters: int) -> dict:
+def bench_frag(iters: int, **scale) -> dict:
     """kai-pulse fragmentation scenario @ 10k nodes / 70k running pods:
     a rack-required 256-pod gang is unplaceable while ~10k free devices
     sit stranded one-per-node across 40 racks.  Measures the full cycle
     p99 WITH the analytics pass against an analytics-off twin (the
-    <10%-overhead acceptance bar), and proves the fragmentation gauge
-    is predictive: high while the gang is stranded, dropping once a
-    rack is freed and the gang places."""
+    <10%-overhead acceptance bar), proves the fragmentation gauge is
+    predictive (high while stranded, dropping once a rack frees), and —
+    BENCH_r06+ — runs the kai-repack solver on a movable-filler twin
+    (repack_solve_ms / migrations_per_unblocked_gang /
+    cycles_to_unblock) plus a repack-off twin proving zero overhead and
+    identical wire bytes while the trigger sits below threshold."""
     import numpy as np
 
+    from kai_scheduler_tpu.binder import Binder
     from kai_scheduler_tpu.framework.scheduler import (Scheduler,
                                                        SchedulerConfig)
+    gang_pods = scale.get("gang_pods", 256)
 
-    def timed_cycles(every: int):
-        cluster = _frag_cluster_10k()
-        sched = Scheduler(SchedulerConfig(analytics_every=every))
+    def timed_cycles(every: int, repack_enable: bool = True,
+                     repack_threshold: float = 1.1):
+        # repack idles through the timed loop: the threshold sits above
+        # any possible score, so enabled-vs-disabled twins measure the
+        # trigger's pure host overhead (the zero-overhead bar)
+        cluster = _frag_cluster_10k(**scale)
+        sched = Scheduler(SchedulerConfig(
+            analytics_every=every, repack_enable=repack_enable,
+            repack_frag_threshold=repack_threshold))
         res = sched.run_once(cluster)  # compile
-        times, an_s = [], []
+        times, an_s, wire = [], [], []
         for _ in range(max(3, iters)):
             t0 = time.perf_counter()
             res = sched.run_once(cluster)
             times.append(time.perf_counter() - t0)
             an_s.append(res.analytics_seconds)
-        return _p99(times), float(np.mean(an_s)), res, sched, cluster
+            wire.append(res.wire["bytes"])
+        return _p99(times), float(np.mean(an_s)), res, sched, cluster, \
+            wire
 
-    p99_on, analytics_ms, res, sched, cluster = timed_cycles(every=1)
+    p99_on, analytics_ms, res, sched, cluster, wire_on = \
+        timed_cycles(every=1)
     analytics_ms *= 1e3
-    p99_off, _, _, _, _ = timed_cycles(every=0)
+    p99_off, _, _, _, _, _ = timed_cycles(every=0)
     frag = res.analytics["fragmentation"]
     stranded = {
         "score": frag["score"],
@@ -756,6 +773,62 @@ def bench_frag(iters: int) -> dict:
     cluster.tick()
     res2 = sched.run_once(cluster)
     frag2 = res2.analytics["fragmentation"]
+
+    # --- kai-repack columns (BENCH_r06+) ------------------------------
+    # (a) zero-overhead twin: the headline run above is repack-ENABLED
+    # with the gauge pinned below its threshold (repack_threshold=1.1),
+    # so comparing it to a repack-DISABLED twin measures the trigger's
+    # whole untriggered cost — wall time and wire bytes must match
+    p99_rp_off, _, _, _, _, wire_off = timed_cycles(
+        every=1, repack_enable=False)
+    repack_off_twin = {
+        "p99_ms_repack_idle": round(p99_on, 1),
+        "p99_ms_repack_off": round(p99_rp_off, 1),
+        "wire_bytes_identical": wire_off == wire_on,
+    }
+    # (b) proactive unblock: the SAME scenario with movable fillers and
+    # consolidation excluded (isolating the proactive path) — cycles
+    # from trigger firing to the 256-pod gang's placement
+    rp_cluster = _frag_cluster_10k(preemptible=True, **scale)
+    rp_sched = Scheduler(SchedulerConfig(
+        actions=("allocate", "reclaim", "preempt", "stalegangeviction"),
+        repack_frag_threshold=0.2, repack_trigger_cycles=2,
+        repack_cooldown=4))
+    binder = Binder()
+    # warm the solver's compile cache at the production shapes (a
+    # throwaway scheduler on a cluster copy, trigger tuned to fire on
+    # its 2nd cycle) so the recorded repack_solve_ms is the
+    # steady-state dispatch cost, not trace+XLA-compile of the
+    # first-ever firing
+    import copy
+    warm_cluster = copy.deepcopy(rp_cluster)
+    warm_sched = Scheduler(SchedulerConfig(
+        actions=("allocate", "reclaim", "preempt", "stalegangeviction"),
+        repack_frag_threshold=0.2, repack_trigger_cycles=1,
+        repack_cooldown=0))
+    warm_sched.run_once(warm_cluster)
+    warm_sched.run_once(warm_cluster)
+    fired = placed = None
+    solve_ms = migrations = 0.0
+    for cyc in range(1, 12):
+        r = rp_sched.run_once(rp_cluster)
+        if r.repack and fired is None:
+            fired = cyc
+            solve_ms = r.repack_seconds * 1e3
+            migrations = r.repack["migrations_executed"]
+        if sum(b.pod_name.startswith("big-")
+               for b in r.bind_requests) >= gang_pods:
+            placed = cyc
+            break
+        binder.reconcile(rp_cluster)
+        rp_cluster.tick()
+    repack_cols = {
+        "repack_solve_ms": round(solve_ms, 2),
+        "migrations_per_unblocked_gang": migrations,
+        "cycles_to_unblock": (placed - fired
+                              if placed and fired else None),
+        "unblocked": bool(placed),
+    }
     extra = {
         "p99_ms_analytics_off": round(p99_off, 1),
         "analytics_dispatch_ms": round(analytics_ms, 2),
@@ -772,7 +845,9 @@ def bench_frag(iters: int) -> dict:
         "fairness_drift": res.analytics["fairness"]["drift_max"],
         "predictive": bool(
             stranded["score"] > frag2["score"]
-            and len(res2.bind_requests) >= 256),
+            and len(res2.bind_requests) >= gang_pods),
+        "repack": repack_cols,
+        "repack_off_twin": repack_off_twin,
     }
     return {"metric": ("frag cycle p99 @ 10k nodes / 70k running pods, "
                        "256-pod rack-required gang stranded "

@@ -39,6 +39,7 @@ from ..framework.session import (SessionConfig, _pack_commit,
                                  _set_fair_share_jit)
 from ..ops import analytics as pulse
 from ..ops import drf
+from ..ops import repack as repack_ops
 from ..ops.allocate import (AllocateConfig, allocate, allocate_jit,
                             init_result)
 from ..ops.stale import stale_gang_eviction
@@ -223,6 +224,20 @@ def _registry() -> list[ProbeSpec]:
             lambda env: ((env[0], _probe_result(env),
                           jnp.zeros((env[0].gangs.g,), jnp.float32)),
                          dict(config=pulse.AnalyticsConfig()))),
+        ProbeSpec(
+            # kai-repack defragmentation solver (ops/repack.py):
+            # dispatched only on fired trigger cycles, but its jaxpr
+            # must honor the same no-callback/f32/compile-once budgets
+            # as the every-cycle kernels — probed with a zeroed
+            # pending-age vector at the canonical shapes
+            "repack",
+            functools.partial(repack_ops.plan_repack,
+                              config=repack_ops.RepackConfig()),
+            repack_ops.plan_repack_jit,
+            lambda env: ((env[0],
+                          jnp.zeros((env[0].gangs.g,), jnp.float32),
+                          env[0].nodes.free),
+                         dict(config=repack_ops.RepackConfig()))),
         ProbeSpec(
             "cumsum_ds",
             numerics.cumsum_ds,

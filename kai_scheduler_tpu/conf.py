@@ -141,7 +141,31 @@ def load_config(doc: dict | str | None,
     if "staleGangGracePeriodSeconds" in doc:
         session = dataclasses.replace(
             session, stale_grace_s=float(doc["staleGangGracePeriodSeconds"]))
+    if "rackLevel" in doc:
+        # THE rack-domain knob: one document key sets the topology level
+        # the kai-pulse fragmentation gauges AND the kai-repack solver
+        # treat as the rack.  Repack has no rack knob of its own — it
+        # derives its domains from this AnalyticsConfig by construction
+        # (ops/repack.RepackConfig embeds it), so a mismatch between
+        # trigger and solver is unrepresentable.
+        session = dataclasses.replace(
+            session, analytics=dataclasses.replace(
+                session.analytics, rack_level=int(doc["rackLevel"])))
     out = dataclasses.replace(cfg, session=session)
+    repack_doc = doc.get("repack") or {}
+    if repack_doc:
+        out = dataclasses.replace(
+            out,
+            repack_enable=bool(repack_doc.get(
+                "enabled", out.repack_enable)),
+            repack_frag_threshold=float(repack_doc.get(
+                "fragThreshold", out.repack_frag_threshold)),
+            repack_trigger_cycles=int(repack_doc.get(
+                "triggerCycles", out.repack_trigger_cycles)),
+            repack_cooldown=int(repack_doc.get(
+                "cooldownCycles", out.repack_cooldown)),
+            repack_max_migrations=int(repack_doc.get(
+                "maxMigrations", out.repack_max_migrations)))
     if "actions" in doc:
         out = dataclasses.replace(out,
                                   actions=_parse_actions(doc["actions"]))
@@ -190,6 +214,14 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "tiers": list(placement.tiers),
         },
         "staleGangGracePeriodSeconds": cfg.session.stale_grace_s,
+        "rackLevel": cfg.session.analytics.rack_level,
+        "repack": {
+            "enabled": cfg.repack_enable,
+            "fragThreshold": cfg.repack_frag_threshold,
+            "triggerCycles": cfg.repack_trigger_cycles,
+            "cooldownCycles": cfg.repack_cooldown,
+            "maxMigrations": cfg.repack_max_migrations,
+        },
         "incremental": cfg.incremental,
         "verifyIncremental": cfg.verify_incremental,
         "incrementalDirtyThreshold": cfg.incremental_dirty_threshold,

@@ -203,6 +203,31 @@ gang_starvation_age = registry.gauge(
     "Pending age in cycles for the top-K oldest starving gangs (the "
     "kai-pulse on-device top-K table; series update on analytics "
     "cycles)", label_names=("gang",))
+# kai-repack proactive defragmentation (ops/repack.py): the
+# constraint-based migration solver the fragmentation gauge gates —
+# fired when frag_score stays above SchedulerConfig.repack_frag_threshold
+# for repack_trigger_cycles consecutive analytics cycles while a
+# rack-required gang starves cluster-feasible-but-rack-stranded
+repack_trigger_firings = registry.counter(
+    "kai_repack_trigger_firings_total",
+    "Repack solver dispatches (the fragmentation trigger fired; "
+    "feasible or not, each firing starts the cooldown)")
+repack_migrations_planned = registry.counter(
+    "kai_repack_migrations_planned_total",
+    "Migrations in feasible repack plans (bounded per firing by "
+    "min(repack_max_migrations, VictimConfig.max_victim_pods))")
+repack_migrations_executed = registry.counter(
+    "kai_repack_migrations_executed_total",
+    "Repack migrations committed as evictions with pipelined rebinds "
+    "(planned moves dropped by cross-dispatch guards are not executed)")
+repack_solve_seconds = registry.histogram(
+    "kai_repack_solve_seconds",
+    "Host-side repack solve dispatch latency per firing (device time "
+    "overlaps the cycle's device_wait phase)")
+repack_gangs_unblocked = registry.counter(
+    "kai_repack_gangs_unblocked_total",
+    "Target gangs that placed within the post-firing observation "
+    "window after their repack migrations committed")
 
 
 def catalog() -> list[dict]:

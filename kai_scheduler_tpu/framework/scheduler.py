@@ -27,6 +27,7 @@ from ..apis import types as apis
 from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
                             init_result)
 from ..ops.analytics import cluster_analytics_jit
+from ..ops.repack import RepackConfig, plan_repack_jit
 from ..ops.stale import stale_gang_eviction
 from ..ops.victims import run_victim_action, run_victim_action_jit
 from ..runtime import compile_watch
@@ -127,6 +128,12 @@ class CycleResult:
     #: host-side dispatch cost of the analytics pass (the device work
     #: itself overlaps the solve and lands in ``device_wait``)
     analytics_seconds: float = 0.0
+    #: kai-repack migration-plan document (ops/repack.py) — empty on
+    #: every cycle the trigger did not fire (the overwhelming majority:
+    #: non-fired cycles dispatch nothing and ship zero extra bytes)
+    repack: dict = dataclasses.field(default_factory=dict)
+    #: host-side dispatch cost of the repack solve (0.0 when not fired)
+    repack_seconds: float = 0.0
 
 
 class Action(Protocol):
@@ -258,6 +265,29 @@ class SchedulerConfig:
     #: pending age (in cycles) at which a gang fires a ``starved``
     #: DecisionLog event + the starvation alarm gauges; 0 disables
     starvation_alarm_cycles: int = 32
+    #: kai-repack (ops/repack.py): proactively migrate movable running
+    #: pods to defragment rack-level capacity for a stranded gang.
+    #: The trigger is host-side and cheap — it fires ONLY when the
+    #: kai-pulse fragmentation score exceeded ``repack_frag_threshold``
+    #: for ``repack_trigger_cycles`` CONSECUTIVE analytics cycles AND
+    #: the last analytics doc shows a starving gang plus a
+    #: cluster-feasible-but-rack-stranded ladder rung AND the snapshot
+    #: carries required topology at all; every other cycle pays zero
+    #: dispatches and zero wire bytes.  Disabled = byte-identical
+    #: commits to the repack-free scheduler.
+    repack_enable: bool = True
+    #: kai-pulse ``frag_score`` above which a cycle counts toward the
+    #: trigger streak
+    repack_frag_threshold: float = 0.5
+    #: consecutive high-fragmentation analytics cycles required to fire
+    repack_trigger_cycles: int = 2
+    #: cycles to wait after a firing (feasible or not) before the next
+    #: — repack must never storm migrations
+    repack_cooldown: int = 8
+    #: per-firing migration cap and plan width; the effective budget is
+    #: ``min(repack_max_migrations, VictimConfig.max_victim_pods)`` so
+    #: repack can never out-migrate the victim machinery.  0 disables.
+    repack_max_migrations: int = 64
 
 
 def apply_shard_args(session: SessionConfig,
@@ -347,6 +377,16 @@ class Scheduler:
         #: (atomic-swap discipline: handler threads read the current
         #: binding; the cycle thread swaps in a fresh immutable dict)
         self._last_analytics: dict = {}
+        #: kai-repack trigger state (host-owned, cycle-thread only):
+        #: consecutive analytics cycles with frag_score above the
+        #: threshold, cycles left in the post-firing cooldown, gangs a
+        #: firing migrated for (name -> cycles left to observe the
+        #: unblock), and the last firing's immutable plan document
+        #: (atomic-swap, served by GET /debug/repack)
+        self._frag_streak: int = 0
+        self._repack_cooldown: int = 0
+        self._repack_watch: dict[str, int] = {}
+        self._last_repack: dict = {}
         self._actions: list[tuple[str, Action]] = [
             (name, _ACTION_REGISTRY[name]()) for name in self.config.actions]
 
@@ -485,6 +525,7 @@ class Scheduler:
             # actions above, so its device time overlaps and lands in
             # device_wait; the bundle rides the packed commit transfer.
             bundle = None
+            ages = None
             every = self.config.analytics_every
             run_analytics = every > 0 and self._cycle_index % every == 0
             self._cycle_index += 1
@@ -496,6 +537,30 @@ class Scheduler:
                         session.state, result.tensors, ages,
                         config=session.config.analytics)
                 result.analytics_seconds = time.perf_counter() - ta
+            # kai-repack: dispatch the defragmentation solve ONLY when
+            # the host trigger fires (ops/repack.py) — every other
+            # cycle pays a few attribute reads and nothing else (the
+            # zero-overhead-below-threshold acceptance bar)
+            repack_plan = None
+            if self._repack_trigger(cluster, session):
+                ta = time.perf_counter()
+                with self.tracer.span("repack"):
+                    if ages is None:
+                        ages = self._pending_age_vector(cluster, session)
+                    # destinations draw on the POST-decision idle pool
+                    # (result.tensors.free) so the plan never races the
+                    # cycle's own placements for the same capacity
+                    repack_plan = plan_repack_jit(
+                        session.state, ages, result.tensors.free,
+                        config=RepackConfig(
+                            analytics=session.config.analytics,
+                            max_migrations=min(
+                                self.config.repack_max_migrations,
+                                session.config.victims.max_victim_pods)))
+                result.repack_seconds = time.perf_counter() - ta
+                metrics.repack_trigger_firings.inc()
+                metrics.repack_solve_seconds.observe(
+                    value=result.repack_seconds)
         t_solve = time.perf_counter()
         # commit: translate the final tensors into BindRequests/evictions
         # and write them back through the API hub (Statement.Commit).
@@ -505,30 +570,51 @@ class Scheduler:
         # is link + device time, not host work).
         with self.tracer.span("device_wait", device_sync=True):
             host = session.gather_host(result.tensors, analytics=bundle)
+            plan_host = None
+            if repack_plan is not None:
+                # the repack plan is tiny (≤ P pairs + scalars) and only
+                # exists on fired cycles — its transfer shares the
+                # cycle's one device sync window
+                plan_host = {
+                    f: np.asarray(getattr(repack_plan, f))
+                    for f in repack_plan.__dataclass_fields__}
         t_gather = time.perf_counter()
+        repack_target = ""
         with self.tracer.span("host_decode"):
             result.bind_requests = session.bind_requests_from(
                 result.tensors, host=host)
             result.evictions = session.evictions_from(
                 result.tensors.victim, result.tensors.victim_move,
                 host=host)
+            if plan_host is not None:
+                tg = int(plan_host["target_gang"])
+                names = session.index.gang_names
+                repack_target = names[tg] if 0 <= tg < len(names) else ""
+                repack_evs = session.repack_evictions(
+                    plan_host, host, repack_target)
+                # repack migrations join the ONE eviction list: the
+                # commit loop below moves them through the same
+                # pipelined-rebind path as consolidation victims
+                result.evictions = result.evictions + repack_evs
+                self._record_repack(plan_host, repack_evs, repack_target,
+                                    result)
         t_decode = time.perf_counter()
         with self.tracer.span("commit"):
             with self.tracer.span("writes"):
                 for br in result.bind_requests:
                     cluster.create_bind_request(br)
                 for ev in result.evictions:
-                    # consolidation victims restart and get a pipelined
-                    # rebind on their verified target node — evicted, not
-                    # lost (ref consolidation.go allPodsReallocated +
-                    # stmt pipelining)
+                    # moved victims (consolidation moves AND kai-repack
+                    # migrations) restart and get a pipelined rebind on
+                    # their verified target node — evicted, not lost
+                    # (ref consolidation.go allPodsReallocated + stmt
+                    # pipelining); both flavors commit through the ONE
+                    # Session.pipelined_rebind helper
                     cluster.evict_pod(ev.pod_name,
                                       restart=ev.move_to is not None)
                     if ev.move_to is not None:
-                        pod = cluster.pods.get(ev.pod_name)
-                        if pod is not None:
-                            rebind = session.move_bind_request(
-                                pod, ev.move_to)
+                        rebind = session.pipelined_rebind(cluster, ev)
+                        if rebind is not None:
                             result.move_bind_requests.append(rebind)
                             cluster.create_bind_request(rebind)
             result.commit_seconds = time.perf_counter() - t_solve
@@ -541,7 +627,8 @@ class Scheduler:
                         errors=self.status_updater.errors)
             events, dropped, counts = session.decision_events(
                 result.tensors, host=host, evictions=result.evictions,
-                limit=self.decisions.max_events_per_cycle)
+                limit=self.decisions.max_events_per_cycle,
+                repack_for=repack_target)
             # kai-pulse starvation: advance the per-gang pending-age
             # counters and fire `starved` events for gangs crossing the
             # alarm threshold this cycle (crossings counted EXACTLY;
@@ -564,6 +651,18 @@ class Scheduler:
                 # atomic swap: published doc is never mutated, so
                 # /debug/cluster reads it without the server state lock
                 self._last_analytics = result.analytics
+                # kai-repack trigger streak: consecutive analytics
+                # cycles with the fragmentation gauge above threshold
+                score = float(host["analytics"]["frag_score"])
+                self._frag_streak = (
+                    self._frag_streak + 1
+                    if score > self.config.repack_frag_threshold else 0)
+            # kai-repack unblock accounting: a gang a firing migrated
+            # for that places within the observation window counts as
+            # unblocked (the kai_repack_gangs_unblocked_total payoff
+            # metric).  The dict is empty on every non-repack cycle.
+            if self._repack_watch:
+                self._watch_repack_unblocks(session, host)
             # kai-wire: close this cycle's transfer window.  The
             # summary rides the result (healthz/bench) and the trace as
             # Chrome counter lanes — bytes-on-wire and live-bytes step
@@ -655,14 +754,135 @@ class Scheduler:
         return self._last_analytics
 
     def _scope_ages(self, cluster: Cluster) -> None:
-        """Reset the pending-age counters when the Scheduler is pointed
-        at a different cluster document (the HTTP server reuses one
+        """Reset the pending-age counters — and the kai-repack trigger
+        state derived from them — when the Scheduler is pointed at a
+        different cluster document (the HTTP server reuses one
         Scheduler across documents — same discipline as the fit
         shadow)."""
         if (self._age_cluster is None
                 or self._age_cluster() is not cluster):
             self._pending_age.clear()
+            self._frag_streak = 0
+            self._repack_cooldown = 0
+            self._repack_watch.clear()
+            # the trigger reads this doc — a new cluster must not
+            # inherit the previous document's stranded/starving signal
+            self._last_analytics = {}
             self._age_cluster = weakref.ref(cluster)
+
+    # -- kai-repack (ops/repack.py) ---------------------------------------
+
+    def _repack_trigger(self, cluster: Cluster,
+                        session: Session) -> bool:
+        """The host-side repack gate — a handful of attribute reads per
+        cycle, no device work.  Fires when the fragmentation gauge has
+        been high for ``repack_trigger_cycles`` consecutive analytics
+        cycles AND the last kai-pulse doc shows a starving gang plus a
+        cluster-feasible-but-rack-stranded ladder rung AND the snapshot
+        carries required topology (no rack-required gang can exist
+        without it), outside the post-firing cooldown."""
+        cfg = self.config
+        # scope BEFORE reading trigger state: a re-pointed Scheduler
+        # must not fire off the previous cluster's streak/doc
+        self._scope_ages(cluster)
+        if (not cfg.repack_enable or cfg.repack_max_migrations <= 0
+                or cfg.analytics_every <= 0):
+            return False
+        if self._repack_cooldown > 0:
+            self._repack_cooldown -= 1
+            return False
+        if self._frag_streak < max(cfg.repack_trigger_cycles, 1):
+            return False
+        if not session.index.has_required_topology:
+            return False
+        doc = self._last_analytics
+        if not doc:
+            return False
+        ladder = doc.get("fragmentation", {}).get("gang_ladder", ())
+        stranded = any(r["cluster_feasible"] and not r["rack_placeable"]
+                       for r in ladder)
+        starving = bool(doc.get("starvation", {}).get("oldest"))
+        return stranded and starving
+
+    def _record_repack(self, plan: dict, executed: list,
+                       target: str, result: CycleResult) -> None:
+        """Account one repack firing: metrics, the cooldown that keeps
+        repack from storming, the unblock watch, and the immutable
+        ``GET /debug/repack`` plan document (atomic-swap)."""
+        from . import metrics
+        cfg = self.config
+        planned = int(plan["num_moves"])
+        metrics.repack_migrations_planned.inc(by=float(planned))
+        metrics.repack_migrations_executed.inc(by=float(len(executed)))
+        # cooldown applies whether or not the solve found a feasible
+        # plan — an infeasible instance will stay infeasible until the
+        # cluster changes, and re-solving it every cycle IS the storm
+        self._repack_cooldown = max(cfg.repack_cooldown, 0)
+        if executed and target:
+            # +2, not +1: _watch_repack_unblocks already decrements this
+            # entry later in the SAME cycle (the firing cycle, where the
+            # target is pending by construction), so the window must
+            # survive cooldown + 1 further cycles of observation
+            self._repack_watch[target] = max(cfg.repack_cooldown, 0) + 2
+        doc = {
+            "feasible": bool(plan["feasible"]),
+            "target_gang": target,
+            "target_rack": int(plan["target_rack"]),
+            "needed_unit_pods": float(plan["needed"]),
+            "rack_units_before": float(plan["rack_units_before"]),
+            "rack_units_after": float(plan["rack_units_after"]),
+            "total_unit_pods": float(plan["total_units"]),
+            "migrations_planned": planned,
+            "migrations_executed": len(executed),
+            "solve_seconds": result.repack_seconds,
+            # complete by construction: executed is already bounded by
+            # min(repack_max_migrations, VictimConfig.max_victim_pods)
+            "moves": [{"pod": ev.pod_name, "to": ev.move_to}
+                      for ev in executed],
+        }
+        result.repack = doc
+        self._last_repack = doc
+
+    def _watch_repack_unblocks(self, session: Session,
+                               host: dict) -> None:
+        from . import metrics
+        names = session.index.gang_names
+        allocated = host["allocated"]
+        for nm in list(self._repack_watch):
+            try:
+                gi = names.index(nm)
+            except ValueError:
+                gi = -1
+            if 0 <= gi < len(allocated) and allocated[gi]:
+                metrics.repack_gangs_unblocked.inc()
+                del self._repack_watch[nm]
+                continue
+            self._repack_watch[nm] -= 1
+            if self._repack_watch[nm] <= 0:
+                del self._repack_watch[nm]
+
+    @property
+    def last_repack(self) -> dict:
+        """The most recent kai-repack firing's plan document (empty
+        before the first firing) — atomic-swap discipline like
+        ``last_analytics``."""
+        return self._last_repack
+
+    def repack_status(self) -> dict:
+        """The ``GET /debug/repack`` payload: trigger knobs + live
+        trigger state + the last firing's plan document."""
+        cfg = self.config
+        return {
+            "ok": bool(self._last_repack),
+            "enabled": cfg.repack_enable,
+            "frag_threshold": cfg.repack_frag_threshold,
+            "trigger_cycles": cfg.repack_trigger_cycles,
+            "cooldown_cycles": cfg.repack_cooldown,
+            "max_migrations": cfg.repack_max_migrations,
+            "frag_high_streak": self._frag_streak,
+            "cooldown_remaining": self._repack_cooldown,
+            "last": self._last_repack,
+        }
 
     def _pending_age_vector(self, cluster: Cluster,
                             session: Session) -> "np.ndarray":

@@ -30,6 +30,10 @@ Reference surfaces collapse into one stdlib HTTP server:
   (``ops/analytics.py``): fragmentation (gang ladder, stranded
   capacity, free histograms), utilization/goodput, fairness drift, and
   the starvation top-K table of the latest analytics cycle.
+- ``GET /debug/repack`` — the kai-repack defragmentation solver
+  (``ops/repack.py``): trigger knobs, live trigger state (consecutive
+  high-fragmentation cycles, cooldown remaining), and the last
+  firing's bounded migration plan.
 - ``GET /debug``        — machine-readable index of every debug
   surface with one-line descriptions and live query params, so
   operators stop grepping this file.
@@ -78,6 +82,10 @@ DEBUG_SURFACES = (
      "desc": ("kai-pulse cluster health: fragmentation gang ladder + "
               "stranded capacity, utilization/goodput, fairness "
               "drift, starvation top-K (latest analytics cycle)")},
+    {"path": "/debug/repack", "params": (),
+     "desc": ("kai-repack defragmentation solver: trigger knobs + live "
+              "trigger state (frag streak, cooldown) and the last "
+              "firing's bounded migration plan")},
     {"path": "/debug/pprof", "params": (),
      "desc": ("one profiled cycle (cProfile): hottest host functions "
               "+ kai-trace phase breakdown")},
@@ -380,6 +388,17 @@ class SchedulerServer:
                         "starvation_alarm_cycles":
                             sched.config.starvation_alarm_cycles,
                         "ok": bool(doc)})
+                elif self.path.startswith("/debug/repack"):
+                    # kai-repack status: knobs + trigger state + the
+                    # LAST firing's plan doc.  Same discipline as
+                    # /debug/cluster — only the scheduler handle is
+                    # read under the state lock; the plan doc is
+                    # atomic-swapped by the cycle thread and never
+                    # mutated after publication, the trigger counters
+                    # are single-writer ints (GIL-atomic reads).
+                    with outer._state_lock:
+                        sched = outer.scheduler
+                    self._send(sched.repack_status())
                 elif self.path in ("/debug", "/debug/"):
                     # index of every debug surface — static doc plus
                     # which optional surfaces are live right now
@@ -551,6 +570,14 @@ class SchedulerServer:
                     "oldest_pending_age_cycles": max(
                         [o["age_cycles"] for o
                          in pulse["starvation"]["oldest"]], default=0),
+                }
+            # kai-repack slice: present only on cycles the trigger fired
+            if result.repack:
+                stats["repack"] = {
+                    "feasible": result.repack["feasible"],
+                    "target_gang": result.repack["target_gang"],
+                    "migrations_executed":
+                        result.repack["migrations_executed"],
                 }
         self._cycle_stats = stats
 

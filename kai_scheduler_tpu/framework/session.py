@@ -551,7 +551,7 @@ class Session:
 
     def decision_events(self, result: AllocationResult,
                         host: dict | None = None, evictions=None,
-                        limit: int = 4096):
+                        limit: int = 4096, repack_for: str = ""):
         """Per-gang outcome events for the cycle — the "why is my job
         not running" surface (``runtime/events.py``).  Returns
         ``(events, dropped, counts)``: a bounded list of
@@ -590,15 +590,24 @@ class Session:
         # exact outcome counts, vectorized — truncation below never
         # skews the /healthz summary
         failed = (reasons != 0) & ~allocated
+        # victim GANGS split by eviction reason: kai-repack migrations
+        # surface as `repacked-for`, everything else as `preempted-for`
+        # — the commit path for both is the ONE pipelined-rebind
+        # helper.  A gang can legitimately appear in BOTH sets in one
+        # cycle (some pods migrated, others plainly preempted) and then
+        # counts — and events below — report both outcomes.
+        repack_groups = {ev.group for ev in evictions or ()
+                         if ev.group and ev.reason == self.REPACK_REASON}
+        plain_groups = {ev.group for ev in evictions or ()
+                        if ev.group and ev.reason != self.REPACK_REASON}
         counts = {
             gang_events.OUTCOME_ALLOCATED: int(allocated.sum()),
             gang_events.OUTCOME_QUOTA_GATE: int(
                 (failed & (reasons == 3)).sum()),
             gang_events.OUTCOME_FIT_FAILURE: int(
                 (failed & (reasons != 3)).sum()),
-            gang_events.OUTCOME_PREEMPTED_FOR: len(
-                {ev.group for ev in evictions if ev.group}
-                if evictions else ()),
+            gang_events.OUTCOME_PREEMPTED_FOR: len(plain_groups),
+            gang_events.OUTCOME_REPACKED_FOR: len(repack_groups),
         }
         counts = {k: v for k, v in counts.items() if v}
         # 1. fit failures (reason code -> outcome + FIT_REASONS detail).
@@ -618,15 +627,33 @@ class Session:
         # 2. preemption/reclaim/consolidation victims, one event per
         # victim GANG (bounded like everything else)
         if evictions:
-            seen: dict[str, bool] = {}
+            # first NON-repack eviction decides a group's plain "moved"
+            # reading (the consolidation-move detail)
+            moved: dict[str, bool] = {}
+            entries: list[tuple[str, str]] = []
+            seen: set[tuple[str, str]] = set()
             for ev in evictions:
-                if ev.group and ev.group not in seen:
-                    seen[ev.group] = ev.move_to is not None
-            groups = list(seen.items())
+                if not ev.group:
+                    continue
+                kind = ("repack" if ev.reason == self.REPACK_REASON
+                        else "plain")
+                if kind == "plain" and ev.group not in moved:
+                    moved[ev.group] = ev.move_to is not None
+                if (ev.group, kind) not in seen:
+                    seen.add((ev.group, kind))
+                    entries.append((ev.group, kind))
             room = max(0, limit - len(out))
-            dropped += max(0, len(groups) - room)
-            for group, moved in groups[:room]:
-                detail = ("consolidation move (pipelined rebind)" if moved
+            dropped += max(0, len(entries) - room)
+            for group, kind in entries[:room]:
+                if kind == "repack":
+                    out.append(gang_events.GangDecision(
+                        gang=group, queue="",
+                        outcome=gang_events.OUTCOME_REPACKED_FOR,
+                        detail=("repack move (pipelined rebind); "
+                                f"frees a rack for: {repack_for}")))
+                    continue
+                detail = ("consolidation move (pipelined rebind)"
+                          if moved.get(group)
                           else (f"freed capacity for: {beneficiaries}"
                                 if beneficiaries else "over fair share"))
                 out.append(gang_events.GangDecision(
@@ -647,6 +674,64 @@ class Session:
                 detail=("pipelined onto releasing capacity"
                         if gi in pipe_set else "")))
         return out, dropped, counts
+
+    def pipelined_rebind(self, cluster,
+                         ev: apis.Eviction) -> apis.BindRequest | None:
+        """THE pipelined-rebind path for a moved victim — consolidation
+        moves and kai-repack migrations both commit through this one
+        helper (the scheduler's commit loop calls it for every eviction
+        carrying a ``move_to`` target), so the two can never drift in
+        bind shape.  Returns None when the pod vanished between solve
+        and commit."""
+        pod = cluster.pods.get(ev.pod_name)
+        if pod is None or ev.move_to is None:
+            return None
+        return self.move_bind_request(pod, ev.move_to)
+
+    #: Eviction.reason marking a kai-repack migration (vs a plain
+    #: consolidation move) — selects the ``repacked-for`` decision
+    #: outcome; the bind/commit path is IDENTICAL for both
+    REPACK_REASON = "repack"
+
+    def repack_evictions(self, plan: dict, host: dict,
+                         target_gang: str) -> list[apis.Eviction]:
+        """A feasible repack plan (host copies of ``RepackPlan`` fields)
+        → evictions with move targets, committed through the SAME
+        pipelined-rebind path as consolidation moves.
+
+        Cross-dispatch guards: pods the cycle's own victim actions
+        already evicted are dropped (their capacity frees anyway), and
+        a plan whose target gang placed this cycle is discarded whole
+        (``[]``) — repack must never migrate for a gang that no longer
+        needs it.
+        """
+        gi = int(plan["target_gang"])
+        if (not bool(plan["feasible"]) or int(plan["num_moves"]) <= 0
+                or not 0 <= gi < len(self.index.gang_names)
+                or self.index.gang_names[gi] != target_gang):
+            return []
+        if host["allocated"][gi]:
+            return []
+        victim = host["victim"]
+        out: list[apis.Eviction] = []
+        names = self.index.running_pod_names_arr
+        gang_all = host["running_gang"]
+        ng = len(self.index.gang_names)
+        for pi, ni in zip(plan["move_pod"].tolist(),
+                          plan["move_node"].tolist()):
+            if pi < 0 or ni < 0 or pi >= len(names) or victim[pi]:
+                continue
+            name = names[pi]
+            if not name:
+                continue
+            gidx = int(gang_all[pi])
+            out.append(apis.Eviction(
+                pod_name=name,
+                group=(self.index.gang_names[gidx]
+                       if 0 <= gidx < ng else ""),
+                reason=self.REPACK_REASON,
+                move_to=self.index.node_names[ni]))
+        return out
 
     def move_bind_request(self, pod: apis.Pod,
                           target_node: str) -> apis.BindRequest:

@@ -215,18 +215,34 @@ def _unit_pods_per_node(free: jax.Array, valid: jax.Array,
     return jnp.where(valid & fits_one, jnp.maximum(units, 0.0), 0.0)
 
 
-def _rack_units(state: ClusterState, units: jax.Array,
-                rack_level: int) -> jax.Array:
-    """f32 [] — unit pods placeable inside the single best rack domain.
-    Nodes without the rack label (or topology-free snapshots) count as
-    their own one-node domain — the degenerate per-node reading."""
+def rack_domain_ids(state: ClusterState, rack_level: int) -> jax.Array:
+    """i32 [N] — dense rack-domain id per node at the given topology
+    level: nodes without the rack label (or topology-free snapshots)
+    count as their own one-node domain (the degenerate per-node
+    reading); invalid node slots map to the junk id ``N*L + N``.
+
+    The SINGLE source of the rack-domain partition: the fragmentation
+    gauges here and the repack solver (``ops/repack.py``) both derive
+    their domains from this function and one ``AnalyticsConfig.
+    rack_level`` knob, so the trigger and the solver can never disagree
+    about what a rack is.
+    """
     n = state.nodes
     N, L = n.n, n.topology.shape[1]
     rl = min(max(rack_level, 0), L - 1)
     dom = n.topology[:, rl]
     node_slot = N * L + jnp.arange(N)
     junk = N * L + N
-    seg = jnp.where(n.valid, jnp.where(dom >= 0, dom, node_slot), junk)
+    return jnp.where(n.valid, jnp.where(dom >= 0, dom, node_slot), junk)
+
+
+def _rack_units(state: ClusterState, units: jax.Array,
+                rack_level: int) -> jax.Array:
+    """f32 [] — unit pods placeable inside the single best rack domain
+    (domains from :func:`rack_domain_ids`)."""
+    n = state.nodes
+    junk = n.n * n.topology.shape[1] + n.n
+    seg = rack_domain_ids(state, rack_level)
     per_dom = jax.ops.segment_sum(units, seg, num_segments=junk + 1)
     return jnp.max(per_dom.at[junk].set(0.0))
 

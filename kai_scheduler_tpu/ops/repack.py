@@ -1,0 +1,290 @@
+"""kai-repack — proactive constraint-based defragmentation solver.
+
+Consolidation moves victims *reactively*, one blocked gang at a time;
+the "Priority Matters" packing paper (PAPERS.md, arxiv 2511.08373)
+treats the cluster as a constraint-based bin-packing instance solved
+*proactively*.  This kernel is that solver for the rack-stranded shape
+the kai-pulse fragmentation gauge detects (``ops/analytics.py``): free
+capacity that could serve a rack-required gang in aggregate, but that
+no single rack domain can host.
+
+One jitted pass over the device-resident snapshot:
+
+1. **target gang** — the oldest starving pending gang (host-owned
+   ``pending_age`` counters, the same vector the analytics kernel
+   consumes) whose required topology level IS the configured rack level,
+   and whose quorum is cluster-feasible by raw free units but
+   rack-stranded (the predicate mirrors the analytics ladder, probed
+   with the gang's own unit request through the allocate
+   ``resource_fit_mask`` predicate).
+2. **min-migration rack selection** — movable running pods (valid,
+   preemptible, not releasing — the victim filter) are ordered by the
+   canonical victim key (priority asc, newest first, index tie-break).
+   Because a node's unit count only grows as pods leave it, each pod's
+   *marginal unit gain* at its position in its node's eviction order is
+   a fixed quantity; per-rack prefix sums of those gains give the EXACT
+   number of canonical-order migrations each rack needs to host the
+   gang — no per-rack simulation loop.  The rack needing the fewest
+   migrations (lowest domain id tie-break) wins, subject to the
+   migration budget (``RepackConfig.max_migrations``, already clamped
+   to ``VictimConfig.max_victim_pods`` by the Session-side caller).
+3. **re-placement** — the selected victims are re-placed OUTSIDE the
+   target rack by canonical ascending-node first fit (the uniform
+   kernel's replica→node canonicalization: interchangeable work takes
+   nodes in ascending id order), each move respecting the pod's
+   node-filter class (taints/affinity — the consolidation-move rule).
+4. **sparse claim verification** — the plan's (node, delta) claim
+   segments are re-verified with the shared
+   ``sparse_accept_first_bad``/``sparse_entry_tables`` protocol from
+   ``ops/allocate.py`` (one implementation; the allocate chunk and the
+   victim wavefront are the other two consumers) and the plan truncates
+   at the first over-subscribed lane — by construction the sequential
+   fill never over-subscribes, so a truncation here means the plan is
+   unsound and it is discarded whole.
+
+The emitted :class:`RepackPlan` is fixed-shape and bounded: at most
+``max_migrations`` (pod → node) moves.  The host turns a feasible plan
+into evictions-with-move-targets that commit through the SAME pipelined
+rebind path as consolidation moves (``Session.pipelined_rebind``), so
+repack introduces no second bind semantics.
+
+Rack-domain single source of truth: the kernel derives the rack level
+from the embedded :class:`~.analytics.AnalyticsConfig` —
+:class:`RepackConfig` deliberately has NO ``rack_level`` field of its
+own, so the trigger gauge and the solver can never disagree about what
+a rack is (``tests/test_repack.py`` pins this by construction).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..runtime import compile_watch
+from ..state.cluster_state import ClusterState
+from . import analytics as pulse
+from .allocate import EPS, sparse_accept_first_bad
+
+#: i32 sentinel for "no migration count" (well above any plan width)
+_BIG = jnp.int32(1 << 30)
+
+
+@dataclasses.dataclass(frozen=True)
+class RepackConfig:
+    """Static knobs of the repack solver (hashable — rides the jit
+    signature like ``AllocateConfig``)."""
+
+    #: the rack-domain + unit-probe knobs, shared verbatim with the
+    #: kai-pulse analytics kernel — the ONE place the rack level lives
+    analytics: pulse.AnalyticsConfig = pulse.AnalyticsConfig()
+    #: migration budget AND plan width: the caller passes
+    #: ``min(SchedulerConfig.repack_max_migrations,
+    #: VictimConfig.max_victim_pods)`` so the repack plan can never
+    #: out-migrate what the victim machinery would allow
+    max_migrations: int = 64
+
+
+class RepackPlan(struct.PyTreeNode):
+    """The fixed-shape bounded migration plan one repack solve emits."""
+
+    move_pod: jax.Array       # i32 [P] running-pod index, -1 unused
+    move_node: jax.Array      # i32 [P] destination node index, -1 unused
+    num_moves: jax.Array      # i32 []  moves in a feasible plan (else 0)
+    feasible: jax.Array       # bool [] plan fully frees the target rack
+    target_gang: jax.Array    # i32 []  gang the plan unblocks, -1 none
+    target_rack: jax.Array    # i32 []  dense rack-domain id, -1 none
+    needed: jax.Array         # f32 []  unit pods the gang still needs
+    rack_units_before: jax.Array  # f32 [] target-rack units pre-plan
+    rack_units_after: jax.Array   # f32 [] target-rack units post-plan
+    total_units: jax.Array    # f32 []  cluster-wide units for the gang
+
+
+def plan_repack(state: ClusterState, pending_age: jax.Array,
+                dest_free: jax.Array, *,
+                config: RepackConfig) -> RepackPlan:
+    """One whole-cluster min-migration repack solve (see module doc).
+
+    ``pending_age`` (f32 [G]) is the host-owned pending-cycles counter
+    per gang slot — the same vector ``cluster_analytics`` consumes, so
+    the trigger's starvation signal and the solver's target choice read
+    one clock.  ``dest_free`` (f32 [N, R]) is the pool migration
+    DESTINATIONS draw on: the scheduler passes the cycle's
+    POST-decision idle pool (``AllocationResult.free``), so a plan
+    fired alongside the action pipeline never re-places a victim onto
+    capacity this cycle's own allocate/consolidation decisions just
+    consumed (a rebind onto stolen capacity would fail in the binder
+    after evicting the pod).  The rack-strandedness analysis stays on
+    the PRE-decision snapshot pool — the signal the trigger gauge read.
+    """
+    n, g, r = state.nodes, state.gangs, state.running
+    N, L = n.n, n.topology.shape[1]
+    M = r.m
+    P = max(1, int(config.max_migrations))
+    rl = min(max(config.analytics.rack_level, 0), L - 1)
+
+    # --- target gang: oldest starving rack-required pending gang ---------
+    cand = g.valid & (g.required_level == rl)
+    age_key = jnp.where(cand, pending_age, -1.0)
+    target = jnp.argmax(age_key).astype(jnp.int32)
+    has_target = age_key[target] > 0.0
+    unit = g.task_req[target, 0]                     # [R] uniform replica
+    needed = jnp.maximum(g.min_needed[target], 0).astype(jnp.float32)
+
+    # --- cluster-feasible-but-rack-stranded, probed with the gang's unit
+    free0 = jnp.maximum(n.free, 0.0)
+    units0 = pulse._unit_pods_per_node(free0, n.valid, unit)       # [N]
+    total_units = jnp.sum(units0)
+    seg = pulse.rack_domain_ids(state, rl)                         # [N]
+    junk = N * L + N
+    SEGS = junk + 1
+    have = jax.ops.segment_sum(units0, seg, num_segments=SEGS)
+    max_rack = jnp.max(have.at[junk].set(0.0))
+    candidacy = (has_target & (needed > 0)
+                 & (total_units >= needed) & (max_rack < needed))
+
+    # --- movable pods + canonical victim order ---------------------------
+    # the consolidation-mode victim filter (``victim_candidates``,
+    # ops/victims.py): preemptible running pods of other gangs, with
+    # minruntime still protecting — a gang whose runtime sits inside
+    # its queue's resolved preempt-minruntime window (consolidation's
+    # protection branch) exposes no movable pods.  gang_runtime is the
+    # ``victim_statics`` formula (-1 = never started => NOT protected).
+    G = g.g
+    gang_runtime = jax.ops.segment_max(
+        jnp.where(r.valid & (r.gang >= 0), r.runtime_s, -1.0),
+        jnp.where(r.gang >= 0, r.gang, G), num_segments=G + 1)[:G]
+    mrt_g = state.queues.preempt_min_runtime_eff[jnp.maximum(g.queue, 0)]
+    prot_g = (gang_runtime >= 0) & (gang_runtime < mrt_g)        # [G]
+    movable = (r.valid & ~r.releasing & r.preemptible & (r.node >= 0)
+               & (r.gang >= 0) & (r.gang != target)
+               & ~prot_g[jnp.clip(r.gang, 0, G - 1)])
+    node_m = jnp.maximum(r.node, 0)
+    # canonical victim key: priority asc, newest (smallest runtime)
+    # first; lexsort is stable, so pod index breaks the remaining ties
+    order = jnp.lexsort((r.runtime_s, r.priority.astype(jnp.float32)))
+    crank = jnp.zeros((M,), jnp.int32).at[order].set(
+        jnp.arange(M, dtype=jnp.int32))
+
+    # --- fixed per-pod marginal unit gains -------------------------------
+    # sort movable pods by (node, canonical rank): the per-node prefix
+    # of freed requests gives each pod's unit gain AT ITS POSITION in
+    # its node's eviction order — a fixed quantity, since unit counts
+    # only grow as capacity frees (see module doc)
+    nkey = jnp.where(movable, node_m, N)
+    p1 = jnp.lexsort((crank, nkey))
+    mov1 = movable[p1]
+    req1 = jnp.where(mov1[:, None], r.req[p1], 0.0)            # [M, R]
+    cs = jnp.cumsum(req1, axis=0)
+    ns = nkey[p1]
+    first = jnp.concatenate([jnp.ones((1,), bool), ns[1:] != ns[:-1]])
+    sidx = lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(M), -1))
+    freed_incl = cs - (cs - req1)[sidx]          # per-node inclusive
+    nsafe = jnp.minimum(ns, N - 1)
+    base_free = free0[nsafe]                                   # [M, R]
+    nvalid = (ns < N) & n.valid[nsafe]
+    u_incl = pulse._unit_pods_per_node(base_free + freed_incl,
+                                       nvalid & mov1, unit)
+    u_excl = pulse._unit_pods_per_node(base_free + freed_incl - req1,
+                                       nvalid & mov1, unit)
+    gain = jnp.zeros((M,), jnp.float32).at[p1].set(
+        jnp.where(mov1, u_incl - u_excl, 0.0))
+
+    # --- per-rack min-migration counts -----------------------------------
+    dkey = jnp.where(movable, seg[node_m], junk)
+    p2 = jnp.lexsort((crank, dkey))
+    mov2 = movable[p2]
+    gain2 = jnp.where(mov2, gain[p2], 0.0)
+    cg = jnp.cumsum(gain2)
+    ds = dkey[p2]
+    first2 = jnp.concatenate([jnp.ones((1,), bool), ds[1:] != ds[:-1]])
+    sidx2 = lax.associative_scan(
+        jnp.maximum, jnp.where(first2, jnp.arange(M), -1))
+    cum_d = cg - (cg - gain2)[sidx2]             # per-rack inclusive
+    rank_in_rack = (jnp.arange(M) - sidx2).astype(jnp.int32)
+    dsafe = jnp.minimum(ds, junk)
+    reach = have[dsafe] + cum_d
+    crosses = (mov2 & (ds < junk) & (reach >= needed)
+               & (rank_in_rack < P))
+    k_cand = jnp.where(crosses, rank_in_rack + 1, _BIG)
+    k_d = jax.ops.segment_min(k_cand, dsafe, num_segments=SEGS)
+    k_d = k_d.at[junk].set(_BIG)
+    best = jnp.argmin(k_d).astype(jnp.int32)     # lowest id breaks ties
+    k_star = k_d[best]
+    feasible_rack = k_star < _BIG
+
+    # --- victim selection (first k_star of the best rack, canonical) -----
+    sel2 = mov2 & (ds == best) & (rank_in_rack < k_star)
+    slot = jnp.where(sel2, rank_in_rack, P)      # [M] plan slot or junk
+    slot_pod = jnp.full((P + 1,), -1, jnp.int32).at[slot].set(
+        jnp.where(sel2, p2.astype(jnp.int32), -1))[:P]
+    rack_after = have[best] + jnp.sum(jnp.where(sel2, gain2, 0.0))
+
+    # --- destination assignment: canonical ascending-node first fit ------
+    dest_ok = n.valid & (seg != best)
+    free_dest0 = jnp.where(dest_ok[:, None],
+                           jnp.maximum(dest_free, 0.0), 0.0)
+    X = n.filter_masks.shape[0]
+
+    def fill(free_d, p_slot):
+        pod = slot_pod[p_slot]
+        psafe = jnp.maximum(pod, 0)
+        vreq = r.req[psafe]
+        fc = jnp.clip(r.filter_class[psafe], 0, X - 1)
+        fit = (dest_ok & n.filter_masks[fc]
+               & jnp.all(free_d + EPS >= vreq[None, :], axis=1))
+        found = jnp.any(fit) & (pod >= 0)
+        node = jnp.where(found, jnp.argmax(fit).astype(jnp.int32), -1)
+        free_d = jnp.where(
+            found, free_d.at[jnp.maximum(node, 0)].add(-vreq), free_d)
+        return free_d, node
+
+    _, nodes_p = lax.scan(fill, free_dest0, jnp.arange(P))
+    placed = nodes_p >= 0
+    all_placed = jnp.all(placed == (slot_pod >= 0))
+
+    # --- sparse (node, delta) claim re-verification ----------------------
+    # the shared accept protocol (ops/allocate.py — third consumer after
+    # the allocate chunk and the victim wavefront): every move is a
+    # pipelined rebind claim against the destination idle pool; the plan
+    # truncates at the first over-subscribing lane.  The sequential fill
+    # above never over-subscribes, so a truncation marks the plan
+    # unsound and it is discarded whole (feasible=False).
+    req_b = jnp.where((slot_pod >= 0)[:, None],
+                      r.req[jnp.maximum(slot_pod, 0)], 0.0)    # [P, R]
+    first_bad, _, _ = sparse_accept_first_bad(
+        nodes_p[:, None], placed[:, None], placed[:, None], req_b,
+        free_dest0, free_dest0, N)
+    verified = first_bad >= P
+
+    feasible = (candidacy & feasible_rack & all_placed & verified
+                & (k_star > 0))
+    move_pod = jnp.where(feasible & placed, slot_pod, -1)
+    move_node = jnp.where(feasible & placed, nodes_p, -1)
+    # scalar outputs gate like target_gang/target_rack: a no-candidate
+    # or no-freeable-rack firing must not publish index-0 junk values
+    # to /debug/repack
+    rack_ok = candidacy & feasible_rack
+    return RepackPlan(
+        move_pod=move_pod, move_node=move_node,
+        num_moves=jnp.where(feasible,
+                            jnp.sum((move_pod >= 0).astype(jnp.int32)),
+                            0).astype(jnp.int32),
+        feasible=feasible,
+        target_gang=jnp.where(candidacy, target, -1).astype(jnp.int32),
+        target_rack=jnp.where(rack_ok, best, -1).astype(jnp.int32),
+        needed=jnp.where(candidacy, needed, 0.0),
+        rack_units_before=jnp.where(rack_ok, have[best], 0.0),
+        rack_units_after=jnp.where(rack_ok, rack_after, 0.0),
+        total_units=jnp.where(candidacy, total_units, 0.0))
+
+
+# kai-wire compile watcher: per-(entry, signature) cache-miss
+# attribution (runtime/compile_watch.py)
+plan_repack_jit = compile_watch.watch(
+    "repack",
+    functools.partial(jax.jit, static_argnames=("config",))(plan_repack))

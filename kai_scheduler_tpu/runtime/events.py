@@ -13,6 +13,10 @@ records its cycle outcome into a bounded per-cycle buffer:
 * ``preempted-for``  — the gang's running pods were evicted to free
   capacity for pending work (detail names the beneficiaries when the
   commit pipelined onto the freed capacity);
+* ``repacked-for``   — the gang's running pods were migrated by the
+  kai-repack defragmentation solver (``ops/repack.py``): evicted with a
+  pipelined rebind onto a node outside the target rack, to free the
+  rack for a stranded large gang (named in the detail);
 * ``starved``        — the gang's pending age crossed the configured
   starvation alarm (``SchedulerConfig.starvation_alarm_cycles``);
   detail carries the FIT_REASONS text of its current blocker
@@ -36,13 +40,14 @@ import threading
 __all__ = [
     "GangDecision", "DecisionLog", "OUTCOME_ALLOCATED",
     "OUTCOME_FIT_FAILURE", "OUTCOME_QUOTA_GATE", "OUTCOME_PREEMPTED_FOR",
-    "OUTCOME_STARVED",
+    "OUTCOME_REPACKED_FOR", "OUTCOME_STARVED",
 ]
 
 OUTCOME_ALLOCATED = "allocated"
 OUTCOME_FIT_FAILURE = "fit-failure"
 OUTCOME_QUOTA_GATE = "quota-gate"
 OUTCOME_PREEMPTED_FOR = "preempted-for"
+OUTCOME_REPACKED_FOR = "repacked-for"
 OUTCOME_STARVED = "starved"
 
 

@@ -178,6 +178,8 @@ def test_known_jit_entry_points_probed():
         "cumsum_ds": {"cumsum_ds"},
         # kai-pulse cluster-health kernel (ops/analytics.py)
         "cluster_analytics": {"analytics"},
+        # kai-repack defragmentation solver (ops/repack.py)
+        "plan_repack": {"repack"},
     }
     graph = PackageGraph(ROOT)
     entries = {q for _m, q in graph._entries()}

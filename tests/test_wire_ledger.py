@@ -380,6 +380,8 @@ def test_compile_watcher_covers_callgraph_jit_entries():
         "run_victim_action_jit": "run_victim_action",
         # kai-pulse cluster-health kernel (ops/analytics.py)
         "cluster_analytics": "analytics",
+        # kai-repack defragmentation solver (ops/repack.py)
+        "plan_repack": "repack",
         # analysis-only probe helper, never on the production cycle
         "cumsum_ds": None,
     }
