@@ -175,6 +175,11 @@ def load_config(doc: dict | str | None,
     if "incremental" in doc:
         out = dataclasses.replace(out,
                                   incremental=bool(doc["incremental"]))
+    if "resident" in doc:
+        # kai-resident device-resident cluster state (ops/resident.py):
+        # patched cycles ship packed journal deltas into donated device
+        # buffers and run the whole cycle as one fused dispatch
+        out = dataclasses.replace(out, resident=bool(doc["resident"]))
     if "verifyIncremental" in doc:
         out = dataclasses.replace(
             out, verify_incremental=bool(doc["verifyIncremental"]))
@@ -223,6 +228,7 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "maxMigrations": cfg.repack_max_migrations,
         },
         "incremental": cfg.incremental,
+        "resident": cfg.resident,
         "verifyIncremental": cfg.verify_incremental,
         "incrementalDirtyThreshold": cfg.incremental_dirty_threshold,
         "pyroscopeAddress": cfg.pyroscope_address,

@@ -24,9 +24,11 @@ import jax
 import numpy as np
 
 from ..apis import types as apis
+from ..ops import drf
+from ..ops import resident as resident_ops
 from ..ops.allocate import (AllocationResult, allocate, allocate_jit,
                             init_result)
-from ..ops.analytics import cluster_analytics_jit
+from ..ops.analytics import cluster_analytics, cluster_analytics_jit
 from ..ops.repack import RepackConfig, plan_repack_jit
 from ..ops.stale import stale_gang_eviction
 from ..ops.victims import run_victim_action, run_victim_action_jit
@@ -36,7 +38,7 @@ from ..runtime.cluster import Cluster
 from ..runtime import events as gang_events
 from ..runtime.events import DecisionLog
 from ..runtime.tracing import CycleTracer
-from .session import FIT_REASONS, Session, SessionConfig
+from .session import FIT_REASONS, Session, SessionConfig, _pack_commit
 
 stale_eviction_jit = compile_watch.watch(
     "stale_gang_eviction",
@@ -89,6 +91,109 @@ def _fused_pipeline(state, fair_share, *, actions, num_levels, acfg,
 # kai-wire compile watcher: per-(entry, signature) cache-miss
 # attribution (runtime/compile_watch.py)
 _fused_pipeline = compile_watch.watch("fused_pipeline", _fused_pipeline)
+
+#: ``_pack_commit``'s raw (unjitted) body — inlined into the fused
+#: resident entry below so the commit pack costs no second dispatch
+_PACK_COMMIT_FN = getattr(_pack_commit, "__wrapped__", _pack_commit)
+
+
+def resident_cycle(state, delta, ages, k_value, *, actions, num_levels,
+                   acfg, vcfg, grace_s, track_devices, analytics_cfg):
+    """kai-resident: ONE fused program for a steady-state patched cycle.
+
+    ``state`` is the device-resident snapshot (DONATED — the caller must
+    never touch the passed-in value again, KAI081); ``delta`` the packed
+    journal delta (``ops/resident.py``).  The chain that used to be up
+    to four dispatches — fair-share division, the action pipeline,
+    kai-pulse analytics, and the packed commit — runs as one XLA
+    program over the in-place-updated state, so a steady cycle is: one
+    small delta upload, one dispatch, one device sync.
+
+    Returns ``(new_state, result, packed)``: the post-delta resident
+    state for the next cycle (aliasing the donated buffers), the
+    commit-set tensors, and the i16 commit array ``gather_host`` syncs.
+    ``analytics_cfg=None`` is an analytics-skipped cadence cycle.
+    """
+    state = resident_ops.apply_delta(state, delta)
+    fair_share = drf.set_fair_share(state, num_levels=num_levels,
+                                    k_value=k_value)
+    solved = state.replace(
+        queues=state.queues.replace(fair_share=fair_share))
+    res = run_actions(solved, fair_share, actions=actions,
+                      num_levels=num_levels, acfg=acfg, vcfg=vcfg,
+                      grace_s=grace_s)
+    bundle = None
+    if analytics_cfg is not None:
+        bundle = cluster_analytics(solved, res, ages,
+                                   config=analytics_cfg)
+    packed = _PACK_COMMIT_FN(res, solved, track_devices=track_devices,
+                             track_analytics=analytics_cfg is not None,
+                             analytics=bundle)
+    # the resident state returns WITHOUT the fair-share replacement:
+    # fair share is derived per cycle, and the device state must stay
+    # leaf-identical to the snapshotter's host mirror (verify compares)
+    return state, res, packed
+
+
+def _resident_donate_argnums() -> tuple[int, ...]:
+    """Donate the resident state only on accelerator backends.
+
+    Donation exists to update the snapshot in place in device memory —
+    on the CPU backend there is no transfer to save, and XLA:CPU's
+    donation path has been OBSERVED to corrupt the scattered-into state
+    under the multi-device host config the test mesh uses (the fused
+    program returns a state whose free pool drifted from the bitwise
+    mirror; identical program without donation is exact).  The CPU
+    carve-out keeps tier-1 bit-exactness unconditional; on TPU the
+    ``verify_incremental`` device gather-and-compare is the guard.
+    """
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend = nothing to donate
+        return ()
+    return () if backend == "cpu" else (0,)
+
+
+#: jitted fused entries keyed by donation tuple — created LAZILY at the
+#: first resident dispatch, never at import: an import-time
+#: ``jax.default_backend()`` would both force backend initialisation on
+#: every package import and freeze the CPU donation carve-out before
+#: the process has picked its platform (a stale ``(0,)`` on a
+#: later-selected CPU backend is exactly the corruption mode the
+#: carve-out exists to prevent)
+_RESIDENT_JIT_CACHE: dict = {}
+
+
+def _resident_jit():
+    donate = _resident_donate_argnums()
+    fn = _RESIDENT_JIT_CACHE.get(donate)
+    if fn is None:
+        # built ONCE per donation tuple and cached above — the KAI032
+        # hazard (a fresh jit callable per call missing the compile
+        # cache) cannot occur; the in-function build is deliberate so
+        # the backend choice is read at first use, not at import
+        fn = functools.partial(  # kai-lint: disable=KAI032
+            jax.jit, donate_argnums=donate, static_argnames=(
+                "actions", "num_levels", "acfg", "vcfg", "grace_s",
+                "track_devices", "analytics_cfg"))(resident_cycle)
+        _RESIDENT_JIT_CACHE[donate] = fn
+        # forward the jit cache probe through the public watched
+        # wrapper so the trace probe's compile-once assertion keeps
+        # seeing the real cache
+        probe = getattr(fn, "_cache_size", None)
+        if probe is not None:
+            _resident_cycle._cache_size = probe
+        _resident_cycle.__kai_jit__ = fn
+    return fn
+
+
+@functools.wraps(resident_cycle)
+def _resident_dispatch(*args, **kwargs):
+    return _resident_jit()(*args, **kwargs)
+
+
+_resident_cycle = compile_watch.watch("resident_cycle",
+                                      _resident_dispatch)
 
 
 @dataclasses.dataclass
@@ -253,8 +358,22 @@ class SchedulerConfig:
     #: automatically for sharded instances (the shard filter re-shapes
     #: the object set per cycle).
     incremental: bool = True
+    #: kai-resident (ops/resident.py): keep the snapshot resident on
+    #: device across cycles — patched cycles upload only a packed
+    #: journal delta and run the WHOLE dispatch chain (delta apply →
+    #: fair share → action pipeline → analytics → packed commit) as
+    #: one fused jit entry with donated state buffers.  Requires the
+    #: incremental engine; structural changes fall back to the full
+    #: build + re-upload path automatically.  Off by default so the
+    #: classic per-leaf patch ship stays the verified reference path;
+    #: the resident bench config and production deployments opt in.
+    resident: bool = False
     #: after every patched refresh, rebuild from scratch and assert the
-    #: patched ClusterState is element-wise identical (debug/CI flag)
+    #: patched ClusterState is element-wise identical (debug/CI flag).
+    #: On the resident path this additionally gathers the device-
+    #: resident state back and compares it leaf-wise against the host
+    #: mirror after every fused apply (non-verify runs never read the
+    #: donated state back).
     verify_incremental: bool = False
     #: dirty fraction above which patching falls back to a full rebuild
     incremental_dirty_threshold: float = 0.35
@@ -413,6 +532,15 @@ class Scheduler:
         pods = [p for p in pods if p.group in keep]
         return nodes, queues, groups, pods, topology
 
+    def _builtin_pipeline(self) -> bool:
+        """True when every configured action still resolves to the
+        shipped builders — the precondition for running the pipeline as
+        one fused program (classic or resident)."""
+        return all(name in _PURE_ACTIONS
+                   and _ACTION_REGISTRY.get(name)
+                   is _BUILTIN_BUILDERS.get(name)
+                   for name in self.config.actions)
+
     def run_once(self, cluster: Cluster) -> CycleResult:
         """One scheduling cycle: snapshot → actions → commit set.
 
@@ -452,6 +580,15 @@ class Scheduler:
             # one extra cycle, never spuriously unschedulable with a
             # stale reason.
             upload_s = 0.0
+            resident_mode = False
+            staged_delta = None
+            # kai-resident engages only over the built-in fused action
+            # pipeline (an overridden action must run eagerly, outside
+            # the one fused entry) and never for sharded instances
+            use_resident = (self.config.resident
+                            and self.config.incremental
+                            and self.config.shard is None
+                            and self._builtin_pipeline())
             if self.config.incremental and self.config.shard is None:
                 # journaled incremental refresh: the snapshotter patches
                 # the previous cycle's snapshot from the cluster's
@@ -468,13 +605,35 @@ class Scheduler:
                         .incremental_dirty_threshold,
                         tracer=self.tracer)
                     self._snapshotter_cluster = weakref.ref(cluster)
-                state, index = self._snapshotter.refresh(
-                    cluster, now=cluster.now, queue_usage=queue_usage)
-                session = Session.from_state(state, index,
-                                             config=self.config.session)
+                if use_resident:
+                    # kai-resident: on patched cycles the snapshotter
+                    # stages only a packed journal delta (uploaded as
+                    # the cycle's ONE device_put) and the device state
+                    # stays put; structural changes land here as mode
+                    # "full" with a freshly built + re-uploaded state
+                    rr = self._snapshotter.refresh_resident(
+                        cluster, now=cluster.now,
+                        queue_usage=queue_usage)
+                    if rr.mode == "resident":
+                        resident_mode = True
+                        staged_delta = rr.delta
+                        session = Session.resident(
+                            rr.index, config=self.config.session,
+                            host_state=rr.host)
+                    else:
+                        session = Session.from_state(
+                            rr.state, rr.index,
+                            config=self.config.session)
+                        session.host_state = rr.host
+                else:
+                    state, index = self._snapshotter.refresh(
+                        cluster, now=cluster.now,
+                        queue_usage=queue_usage)
+                    session = Session.from_state(
+                        state, index, config=self.config.session)
                 # journal-delta stats of THIS refresh onto the span:
-                # mode (patched/full), fallback reason, dirty rows,
-                # changed leaves and bytes actually uploaded
+                # mode (patched/full/resident), fallback reason, dirty
+                # rows, changed leaves and bytes actually uploaded
                 snap_sp.attrs.update(self._snapshotter.stats.last)
                 upload_s = float(
                     self._snapshotter.stats.last.get("ship_seconds", 0.0))
@@ -491,13 +650,66 @@ class Scheduler:
         t_open = time.perf_counter()
         open_s = t_open - t0
         metrics.open_session_latency.observe(value=open_s)
-        result = CycleResult(tensors=init_result(session.state))
+        result = CycleResult()
+        if not resident_mode:
+            result.tensors = init_result(session.state)
         result.open_seconds = open_s
+        packed = None
         with self.tracer.span("solve_dispatch"):
-            if all(name in _PURE_ACTIONS
-                   and _ACTION_REGISTRY.get(name)
-                   is _BUILTIN_BUILDERS.get(name)
-                   for name in self.config.actions):
+            every = self.config.analytics_every
+            run_analytics = every > 0 and self._cycle_index % every == 0
+            self._cycle_index += 1
+            bundle = None
+            ages = None
+            if resident_mode:
+                # kai-resident fast path: delta apply + fair share +
+                # action pipeline + analytics + packed commit as ONE
+                # fused dispatch over the donated device-resident state
+                cfg = session.config
+                ta = time.perf_counter()
+                if run_analytics:
+                    ages = self._pending_age_vector(cluster, session)
+                    ages_arg = ages
+                else:
+                    # cadence-skipped cycle: the fused entry never
+                    # reads `ages` (analytics_cfg=None drops it at
+                    # trace time) — a zeros placeholder skips the
+                    # O(pending) host walk the classic path also
+                    # skips.  `ages` itself stays None so the repack
+                    # block below still computes REAL ages when its
+                    # trigger fires on a non-analytics cycle (an
+                    # all-zero vector would make every plan_repack
+                    # target gate fail and burn the cooldown for
+                    # nothing).
+                    src = (session.host_state
+                           if session.host_state is not None
+                           else session.state)
+                    ages_arg = np.zeros((src.gangs.g,), np.float32)
+                with self.tracer.span("action:resident_cycle"):
+                    donated = self._snapshotter.device_state
+                    new_state, tensors, packed = _resident_cycle(
+                        donated, staged_delta, ages_arg,
+                        np.float32(cfg.k_value),
+                        actions=tuple(self.config.actions),
+                        num_levels=cfg.num_levels, acfg=cfg.allocate,
+                        vcfg=cfg.victims, grace_s=cfg.stale_grace_s,
+                        track_devices=session.index.needs_device_table,
+                        analytics_cfg=(cfg.analytics if run_analytics
+                                       else None))
+                # `donated` is dead past this point (buffers consumed
+                # in place); the post-delta state takes over as both
+                # the session's state and the next cycle's resident base
+                self._snapshotter.adopt_device_state(new_state)
+                session.state = new_state
+                result.tensors = tensors
+                result.action_seconds["resident_cycle"] = \
+                    time.perf_counter() - ta
+                metrics.action_latency.observe(
+                    "resident_cycle",
+                    value=result.action_seconds["resident_cycle"])
+                if self.config.verify_incremental:
+                    self._snapshotter.verify_device_residency()
+            elif self._builtin_pipeline():
                 # fast path: the whole action pipeline as one compiled
                 # program
                 cfg = session.config
@@ -524,12 +736,9 @@ class Scheduler:
             # final commit set (ops/analytics.py) — async like the
             # actions above, so its device time overlaps and lands in
             # device_wait; the bundle rides the packed commit transfer.
-            bundle = None
-            ages = None
-            every = self.config.analytics_every
-            run_analytics = every > 0 and self._cycle_index % every == 0
-            self._cycle_index += 1
-            if run_analytics:
+            # (On resident cycles the kernel already ran INSIDE the
+            # fused entry and the bundle is in `packed` — no dispatch.)
+            if run_analytics and not resident_mode:
                 ta = time.perf_counter()
                 with self.tracer.span("analytics"):
                     ages = self._pending_age_vector(cluster, session)
@@ -569,15 +778,20 @@ class Scheduler:
         # device-sync marker (dispatches above were async, so this wait
         # is link + device time, not host work).
         with self.tracer.span("device_wait", device_sync=True):
-            host = session.gather_host(result.tensors, analytics=bundle)
-            plan_host = None
-            if repack_plan is not None:
-                # the repack plan is tiny (≤ P pairs + scalars) and only
-                # exists on fired cycles — its transfer shares the
-                # cycle's one device sync window
-                plan_host = {
-                    f: np.asarray(getattr(repack_plan, f))
-                    for f in repack_plan.__dataclass_fields__}
+            # ONE batched transfer: the packed commit (analytics bundle
+            # and — on fired classic cycles — the repack plan ride it;
+            # see Session.gather_host).  Resident cycles sync the
+            # packed array the fused entry already produced.
+            if resident_mode:
+                host = session.gather_host(
+                    result.tensors, packed=packed,
+                    packed_analytics=run_analytics,
+                    repack_plan=repack_plan)
+            else:
+                host = session.gather_host(
+                    result.tensors, analytics=bundle,
+                    repack_plan=repack_plan)
+            plan_host = host.get("repack_plan")
         t_gather = time.perf_counter()
         repack_target = ""
         with self.tracer.span("host_decode"):
@@ -643,7 +857,7 @@ class Scheduler:
             self.decisions.record_cycle(trace.cycle_id, events,
                                         dropped=dropped, counts=counts)
             self._record_metrics(session, result, host)
-            if bundle is not None:
+            if host.get("analytics") is not None:
                 result.analytics = session.analytics_doc(
                     host,
                     alarm_cycles=self.config.starvation_alarm_cycles)
@@ -892,7 +1106,11 @@ class Scheduler:
         top-K table, and ``_advance_starvation`` advances the host copy
         identically after decode)."""
         self._scope_ages(cluster)
-        ages = np.zeros((session.state.gangs.g,), np.float32)
+        # shapes come from the host mirror on resident cycles (the
+        # device state is not constructed until the fused dispatch)
+        src = (session.host_state if session.host_state is not None
+               else session.state)
+        ages = np.zeros((src.gangs.g,), np.float32)
         if self._pending_age:
             names = session.index.gang_names
             valid = session.index.host_tables["gang_valid"]
@@ -941,8 +1159,7 @@ class Scheduler:
                 if len(starved) < self.MAX_STARVED_EVENTS:
                     code = int(reasons[gi])
                     if queues_of is None:
-                        queues_of = np.asarray(
-                            session.state.gangs.queue)
+                        queues_of = session._gangs_queue_host()
                     qi = int(queues_of[gi])
                     starved.append(gang_events.GangDecision(
                         gang=name,

@@ -28,6 +28,7 @@ from ..ops import analytics as pulse
 from ..ops import drf
 from ..runtime import compile_watch
 from ..runtime import events as gang_events
+from ..runtime import wire_ledger as _wire
 from ..ops.allocate import AllocateConfig, AllocationResult
 from ..ops.victims import VictimConfig
 from ..state.cluster_state import (ClusterState, SnapshotIndex,
@@ -68,10 +69,12 @@ def _bitunpack(p: "np.ndarray", k: int) -> "np.ndarray":
 
 
 @functools.partial(jax.jit, static_argnames=("track_devices",
-                                              "track_analytics"))
+                                              "track_analytics",
+                                              "track_repack"))
 def _pack_commit(result: AllocationResult, state: ClusterState,
                  *, track_devices: bool, track_analytics: bool = False,
-                 analytics=None) -> jax.Array:
+                 analytics=None, track_repack: bool = False,
+                 repack_plan=None) -> jax.Array:
     q = state.queues
     parts = [
         (result.placements + 1).ravel().astype(jnp.int16),
@@ -99,6 +102,26 @@ def _pack_commit(result: AllocationResult, state: ClusterState,
             jax.lax.bitcast_convert_type(a32, jnp.int16).ravel())
         parts.append(
             jax.lax.bitcast_convert_type(ai, jnp.int16).ravel())
+    if track_repack:
+        # kai-repack: a fired cycle's migration plan rides the packed
+        # commit too (pod indices can exceed i16, so i32/f32 fields
+        # bitcast to i16 pairs) — the plan never costs its own
+        # device→host readback on the classic path
+        parts.append(jax.lax.bitcast_convert_type(
+            repack_plan.move_pod, jnp.int16).ravel())
+        parts.append(jax.lax.bitcast_convert_type(
+            repack_plan.move_node, jnp.int16).ravel())
+        ints = jnp.stack([
+            repack_plan.num_moves, repack_plan.target_gang,
+            repack_plan.target_rack,
+            repack_plan.feasible.astype(jnp.int32)])
+        parts.append(
+            jax.lax.bitcast_convert_type(ints, jnp.int16).ravel())
+        fls = jnp.stack([
+            repack_plan.needed, repack_plan.rack_units_before,
+            repack_plan.rack_units_after, repack_plan.total_units])
+        parts.append(
+            jax.lax.bitcast_convert_type(fls, jnp.int16).ravel())
     return jnp.concatenate(parts)
 
 
@@ -189,6 +212,70 @@ class SessionConfig:
     stale_grace_s: float = 60.0
 
 
+def _auto_tune(config: SessionConfig, index: SnapshotIndex,
+               padded_nodes: int, padded_running: int) -> SessionConfig:
+    """Derive the kernel fast-path flags + wavefront widths from the
+    snapshot's index hints and padded shapes — shared verbatim by the
+    classic :meth:`Session.from_state` open and the kai-resident open
+    (which has only the host mirror's shapes in hand), so the two paths
+    always compile and run the SAME static config."""
+    # a hierarchy deeper than the configured recursion would
+    # leave leaf levels undivided — widen to the snapshot depth
+    if index.max_queue_depth + 1 > config.num_levels:
+        config = dataclasses.replace(
+            config, num_levels=index.max_queue_depth + 1)
+    devices = index.needs_device_table
+    # the whole-gang kernel is exactly the sequential greedy
+    # under BINPACK scoring only (a filling node's score rises,
+    # so the greedy keeps hitting it — the capacity-count fill);
+    # under spread the per-task loop re-ranks after every task,
+    # so spread-configured shards keep the per-task kernel
+    uniform = (index.uniform_gangs and not devices
+               and config.allocate.placement.binpack_accel
+               and config.allocate.placement.binpack_cpu)
+    sub_topo = (index.has_subgroup_topology
+                or index.has_required_topology)
+    ext = index.has_extended_resources
+    dense = index.dense_feasibility
+    return dataclasses.replace(
+        config,
+        allocate=dataclasses.replace(
+            config.allocate, track_devices=devices,
+            uniform_tasks=uniform, subgroup_topology=sub_topo,
+            extended=ext, dense_feasibility=dense,
+            preferred_topology=index.has_preferred_topology,
+            anti_groups=index.has_anti_groups,
+            attract_groups=index.has_attract_groups),
+        victims=dataclasses.replace(
+            config.victims,
+            chunk_reclaim=not index.has_reclaim_minruntime,
+            # auto-tuning v2: lane width follows the snapshot's
+            # live preemptor spread (clamped so junk lanes past
+            # the pending-gang count stop paying freed-pool
+            # cost) under a padded-node-count memory bound; the
+            # compact victim-table width follows running-pod
+            # density per leaf queue (see VictimConfig)
+            batch_size_preempt=(
+                _preempt_lane_width(
+                    config.victims.batch_size,
+                    index.num_pending_gangs,
+                    index.num_leaf_queues, padded_nodes)
+                if config.victims.batch_size_preempt is None
+                else config.victims.batch_size_preempt),
+            sparse_unit_k=(
+                _sparse_unit_width(
+                    padded_running, index.num_leaf_queues)
+                if config.victims.sparse_unit_k is None
+                else config.victims.sparse_unit_k),
+            placement=dataclasses.replace(
+                config.victims.placement, track_devices=devices,
+                uniform_tasks=uniform, subgroup_topology=sub_topo,
+                extended=ext, dense_feasibility=dense,
+                preferred_topology=index.has_preferred_topology,
+                anti_groups=index.has_anti_groups,
+                attract_groups=index.has_attract_groups)))
+
+
 @dataclasses.dataclass
 class Session:
     """One cycle's snapshot + derived tensors."""
@@ -196,6 +283,12 @@ class Session:
     state: ClusterState
     index: SnapshotIndex
     config: SessionConfig
+    #: kai-resident: the snapshotter's numpy mirror of ``state``.  When
+    #: set, host-side decode paths read snapshot columns (gang→queue)
+    #: from it instead of pulling a device-resident leaf back over the
+    #: wire — and never touch a leaf a donated dispatch may have
+    #: consumed (KAI081).
+    host_state: ClusterState | None = None
 
     @classmethod
     def open(
@@ -223,77 +316,63 @@ class Session:
         plugin's share division exactly as :meth:`open` would."""
         config = config or SessionConfig()
         if config.auto_tune:
-            # a hierarchy deeper than the configured recursion would
-            # leave leaf levels undivided — widen to the snapshot depth
-            if index.max_queue_depth + 1 > config.num_levels:
-                config = dataclasses.replace(
-                    config, num_levels=index.max_queue_depth + 1)
-            devices = index.needs_device_table
-            # the whole-gang kernel is exactly the sequential greedy
-            # under BINPACK scoring only (a filling node's score rises,
-            # so the greedy keeps hitting it — the capacity-count fill);
-            # under spread the per-task loop re-ranks after every task,
-            # so spread-configured shards keep the per-task kernel
-            uniform = (index.uniform_gangs and not devices
-                       and config.allocate.placement.binpack_accel
-                       and config.allocate.placement.binpack_cpu)
-            sub_topo = (index.has_subgroup_topology
-                        or index.has_required_topology)
-            ext = index.has_extended_resources
-            dense = index.dense_feasibility
-            config = dataclasses.replace(
-                config,
-                allocate=dataclasses.replace(
-                    config.allocate, track_devices=devices,
-                    uniform_tasks=uniform, subgroup_topology=sub_topo,
-                    extended=ext, dense_feasibility=dense,
-                    preferred_topology=index.has_preferred_topology,
-                    anti_groups=index.has_anti_groups,
-                    attract_groups=index.has_attract_groups),
-                victims=dataclasses.replace(
-                    config.victims,
-                    chunk_reclaim=not index.has_reclaim_minruntime,
-                    # auto-tuning v2: lane width follows the snapshot's
-                    # live preemptor spread (clamped so junk lanes past
-                    # the pending-gang count stop paying freed-pool
-                    # cost) under a padded-node-count memory bound; the
-                    # compact victim-table width follows running-pod
-                    # density per leaf queue (see VictimConfig)
-                    batch_size_preempt=(
-                        _preempt_lane_width(
-                            config.victims.batch_size,
-                            index.num_pending_gangs,
-                            index.num_leaf_queues, state.nodes.n)
-                        if config.victims.batch_size_preempt is None
-                        else config.victims.batch_size_preempt),
-                    sparse_unit_k=(
-                        _sparse_unit_width(
-                            state.running.m, index.num_leaf_queues)
-                        if config.victims.sparse_unit_k is None
-                        else config.victims.sparse_unit_k),
-                    placement=dataclasses.replace(
-                        config.victims.placement, track_devices=devices,
-                        uniform_tasks=uniform, subgroup_topology=sub_topo,
-                        extended=ext, dense_feasibility=dense,
-                        preferred_topology=index.has_preferred_topology,
-                        anti_groups=index.has_anti_groups,
-                        attract_groups=index.has_attract_groups)))
+            config = _auto_tune(config, index, state.nodes.n,
+                                state.running.m)
         fair_share = _set_fair_share_jit(
             state, num_levels=config.num_levels,
             k_value=jnp.float32(config.k_value))
         state = state.replace(queues=state.queues.replace(fair_share=fair_share))
         return cls(state=state, index=index, config=config)
 
+    @classmethod
+    def resident(cls, index: SnapshotIndex,
+                 config: SessionConfig | None = None,
+                 host_state: ClusterState | None = None) -> "Session":
+        """Open a session for a kai-resident cycle: the snapshot is
+        already resident on device and the WHOLE dispatch chain —
+        fair-share division included — runs inside the one fused
+        ``resident_cycle`` entry, so this constructor dispatches
+        nothing.  Auto-tuning reads the host mirror's padded shapes
+        (identical to the device state's by construction); ``state`` is
+        assigned by the scheduler after the fused dispatch returns the
+        post-delta device state."""
+        config = config or SessionConfig()
+        if config.auto_tune and host_state is not None:
+            config = _auto_tune(config, index, host_state.nodes.n,
+                                host_state.running.m)
+        return cls(state=None, index=index, config=config,
+                   host_state=host_state)
+
+    def _gangs_queue_host(self) -> "np.ndarray":
+        """The gang→queue column as host numpy — from the mirror when
+        one exists (resident cycles must not read device leaves back,
+        and must NEVER touch a donated previous-cycle state)."""
+        src = self.host_state if self.host_state is not None else self.state
+        return np.asarray(src.gangs.queue)
+
     # -- commit path ------------------------------------------------------
 
     def gather_host(self, result: AllocationResult,
-                    analytics=None) -> dict:
+                    analytics=None, *, packed=None,
+                    packed_analytics: bool = False,
+                    repack_plan=None) -> dict:
         """ONE compact device→host transfer of the cycle's results,
         merged with the snapshot-side numpy tables the host never let go
         of (see ``_pack_commit``).  ``analytics`` (an
         ``ops.analytics.AnalyticsBundle``, optional) rides the same
         packed array — the kai-pulse bundle never costs a second
-        transfer."""
+        transfer — and so does a fired cycle's kai-repack plan
+        (``repack_plan``), decoded into ``host["repack_plan"]``.
+
+        kai-resident cycles pass ``packed=`` — the i16 commit array the
+        fused ``resident_cycle`` entry already produced on device
+        (``packed_analytics`` says whether the analytics bundle rode
+        it); this method then only syncs that one array.  A repack plan
+        on a resident cycle (rare: the trigger fired) is read back as
+        one accounted batched ``LEDGER.device_get`` instead — the plan
+        was solved in its own dispatch after the fused entry, so it
+        cannot ride the fused pack.
+        """
         g, q, r = self.state.gangs, self.state.queues, self.state.running
         G, T, M, Q = g.g, g.t, r.m, q.q
         R_ = self.state.nodes.free.shape[1]
@@ -302,11 +381,16 @@ class Session:
             # would bind pods to the wrong nodes
             raise ValueError("i16 commit packing needs < 32k nodes")
         devices = self.index.needs_device_table
-        flat = np.asarray(_pack_commit(result, self.state,
-                                       track_devices=devices,
-                                       track_analytics=analytics
-                                       is not None,
-                                       analytics=analytics))
+        plan_from_pack = repack_plan is not None and packed is None
+        if packed is None:
+            has_analytics = analytics is not None
+            flat = np.asarray(_pack_commit(
+                result, self.state, track_devices=devices,
+                track_analytics=has_analytics, analytics=analytics,
+                track_repack=plan_from_pack, repack_plan=repack_plan))
+        else:
+            has_analytics = packed_analytics
+            flat = np.asarray(packed)
 
         def take(n):
             nonlocal off
@@ -339,7 +423,7 @@ class Session:
                                        ).reshape(G, T)
         else:
             out["placement_device"] = np.full((G, T), -1, np.int32)
-        if analytics is not None:
+        if has_analytics:
             acfg = self.config.analytics
             nf = pulse.f32_len(acfg, q=Q, r=R_, g=G)
             ni = pulse.i32_len(acfg, q=Q, r=R_, g=G)
@@ -347,6 +431,25 @@ class Session:
             ai = np.frombuffer(take(ni * 2).tobytes(), np.int32)
             out["analytics"] = pulse.host_unpack(
                 a32, ai, config=acfg, q=Q, r=R_, g=G)
+        if plan_from_pack:
+            P = repack_plan.move_pod.shape[0]
+            mp = np.frombuffer(take(2 * P).tobytes(), np.int32)
+            mn = np.frombuffer(take(2 * P).tobytes(), np.int32)
+            ints = np.frombuffer(take(8).tobytes(), np.int32)
+            fls = np.frombuffer(take(8).tobytes(), np.float32)
+            out["repack_plan"] = {
+                "move_pod": mp, "move_node": mn,
+                "num_moves": ints[0], "target_gang": ints[1],
+                "target_rack": ints[2], "feasible": bool(ints[3]),
+                "needed": fls[0], "rack_units_before": fls[1],
+                "rack_units_after": fls[2], "total_units": fls[3]}
+        elif repack_plan is not None:
+            # resident cycle + fired trigger: the plan is tiny and
+            # rare — one accounted batched readback through the ledger
+            out["repack_plan"] = _wire.LEDGER.device_get(
+                {f: getattr(repack_plan, f)
+                 for f in repack_plan.__dataclass_fields__},
+                reason="repack-plan")
         return out
 
     def bind_requests_from(self, result: AllocationResult,
@@ -485,7 +588,7 @@ class Session:
         qnames = self.index.queue_names
         gnames = self.index.gang_names
         reasons = host["fit_reason"]
-        queues_of = np.asarray(self.state.gangs.queue)
+        queues_of = self._gangs_queue_host()
         drift = a["queue_drift"][:len(qnames)]
         top_q = np.argsort(-drift)[:5]
         oldest = []
@@ -571,7 +674,7 @@ class Session:
         allocated = host["allocated"][:ng]
         reasons = host["fit_reason"][:ng]
         pipelined = host["pipelined"][:ng]
-        queues_of = np.asarray(self.state.gangs.queue)[:ng]
+        queues_of = self._gangs_queue_host()[:ng]
         qnames = self.index.queue_names
         nq = len(qnames)
 

@@ -1,0 +1,242 @@
+"""kai-resident — packed journal deltas + the scatter-apply kernel.
+
+ROADMAP item 1's endgame: the cluster snapshot stays **resident on the
+device across cycles** and patched cycles ship only what changed.  The
+``IncrementalSnapshotter`` keeps maintaining its host-side numpy mirror
+(the fallback / verify source of truth), but instead of re-uploading
+whole changed leaves it emits a **packed journal delta** — fixed-shape
+sparse ``(flat element index, value)`` segments, one pair of arrays per
+leaf dtype class — which the jitted scatter-apply below writes into the
+device-resident :class:`~..state.cluster_state.ClusterState` **in
+place** (the fused cycle entry donates the state buffers via
+``donate_argnums``, so the update never copies the snapshot).
+
+Delta format
+------------
+
+The pytree *structure* of a delta is fixed — one ``(idx, val)`` pair
+per dtype class present in the snapshot (``float32`` / ``int32`` /
+``bool`` for every production snapshot) — so the only thing that varies
+cycle-to-cycle is the padded segment length per class.  Lengths bucket
+to powers of two with a floor (:data:`MIN_BUCKET`), so a steady-churn
+cluster settles onto ONE abstract signature and the fused cycle entry
+compiles once per snapshot shape bucket.
+
+Element addressing is a **virtual concatenation** per group, where a
+group is ``(section, dtype class)`` — ``nodes``/``queues``/``gangs``/
+``running`` × ``float32``/``int32``/``bool``: leaves are numbered in
+pytree-flatten order, and each leaf's elements occupy
+``[offset, offset + leaf.size)`` of its group's flat index space
+(:func:`leaf_layout` — derived purely from the tree paths and
+shapes/dtypes, so the host packer and the traced kernel can never
+disagree).  Padding slots carry ``idx == -1``; the scatter rebases
+every entry per leaf and maps anything outside the leaf's range to
+``leaf.size``, which jax's ``mode="drop"`` scatter discards — so one
+fixed-shape segment table serves every leaf of its group with no
+per-leaf shapes in the signature.  Grouping by section keeps the
+scatter work proportional to ``Σ (leaves in section × section's
+segment length)`` instead of ``total leaves × total length`` — a
+running-section burst (e.g. ``runtime_s`` moving on every tick) is
+scanned only by running-section leaves.
+
+The host packer (:func:`pack_delta`) diffs the new mirror against the
+previous one element-wise (NaN-stable on float leaves, identity-
+short-circuited like the classic ship path) and returns both the delta
+and a merged mirror that reuses the previous cycle's arrays for
+unchanged leaves, so the next diff short-circuits on ``is``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MIN_BUCKET", "DeltaShapeError", "leaf_layout", "pack_delta",
+           "apply_delta", "empty_delta", "delta_nbytes"]
+
+#: minimum padded segment length per (section, dtype-class) group —
+#: small enough that a quiet cycle's delta stays a few KB, large
+#: enough that ordinary churn jitter in near-floor groups never
+#: crosses a bucket boundary (each distinct bucket tuple is a fresh
+#: XLA compile of the fused cycle entry)
+MIN_BUCKET = 256
+
+
+class DeltaShapeError(ValueError):
+    """A leaf changed shape/dtype between mirrors — not patchable (the
+    caller falls back to the full rebuild)."""
+
+
+def _bucket(n: int) -> int:
+    """Padded segment length: 0 stays 0 (a class with no changes ships
+    zero bytes), anything else pads to a pow2 with a floor."""
+    if n <= 0:
+        return 0
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _group_key(path, leaf) -> str:
+    """``section.dtypeclass`` — the segment-table a leaf belongs to."""
+    head = path[0] if path else None
+    section = getattr(head, "name", None) or str(head)
+    return f"{section}.{np.dtype(leaf.dtype).name}"
+
+
+def leaf_layout(paths_leaves) -> list[tuple[str, int]]:
+    """``(group key, flat offset)`` per leaf, in flatten order.
+
+    ``paths_leaves`` is ``tree_flatten_with_path(state)[0]``.  Offsets
+    are running sums of ``leaf.size`` per group — a pure function of
+    the snapshot's tree paths and shapes/dtypes, shared by the host
+    packer and the traced scatter so their element addressing is
+    identical by construction.
+    """
+    cursor: dict[str, int] = {}
+    out = []
+    for path, leaf in paths_leaves:
+        key = _group_key(path, leaf)
+        off = cursor.get(key, 0)
+        out.append((key, off))
+        cursor[key] = off + int(leaf.size)
+    return out
+
+
+def _groups(paths_leaves) -> list[tuple[str, str]]:
+    """Sorted ``(group key, dtype name)`` pairs present in the state."""
+    seen: dict[str, str] = {}
+    for path, leaf in paths_leaves:
+        seen.setdefault(_group_key(path, leaf),
+                        np.dtype(leaf.dtype).name)
+    return sorted(seen.items())
+
+
+def empty_delta(state) -> dict:
+    """A structurally-valid no-op delta for ``state`` (zero-size
+    segments in every group) — the trace probe's canonical argument and
+    the shape template fallback paths reuse."""
+    pl = jax.tree_util.tree_flatten_with_path(state)[0]
+    return {
+        "idx": {k: np.zeros((0,), np.int32) for k, _d in _groups(pl)},
+        "val": {k: np.zeros((0,), np.dtype(d)) for k, d in _groups(pl)},
+    }
+
+
+def delta_nbytes(delta: dict) -> int:
+    """Total bytes the delta puts on the wire (idx + val segments)."""
+    return int(sum(int(a.nbytes)
+                   for part in delta.values() for a in part.values()))
+
+
+def pack_delta(old_state, new_state,
+               min_buckets: dict | None = None
+               ) -> tuple[dict, object, dict]:
+    """Diff two host mirrors into a packed journal delta.
+
+    Returns ``(delta, merged_state, stats)``: the fixed-structure delta
+    dict, a merged mirror whose unchanged leaves keep the OLD array
+    objects (so next cycle's compares short-circuit on identity), and
+    ``stats`` with ``leaves`` / ``elements`` / ``bytes`` (the packed
+    delta size — the number the wire assertion pins upload bytes to)
+    plus ``buckets`` (the padded length chosen per group).
+
+    ``min_buckets`` is the **hysteresis floor** per group — the caller
+    (the snapshotter) feeds back the previous cycle's chosen buckets so
+    segment lengths only ever GROW: without it, a group whose changed
+    count wobbles across a pow2 boundary would flip the fused entry's
+    abstract signature cycle-to-cycle, and every flip is a full XLA
+    recompile of the 17k-eqn resident program.  With it, the signature
+    converges after one cycle and changes again only on genuine growth.
+
+    Raises :class:`DeltaShapeError` when any leaf changed shape or
+    dtype — the caller must fall back to the full rebuild (on the patch
+    path this cannot happen: capacity overflows already force
+    ``_Fallback`` before assembly).
+    """
+    paths_new, treedef = jax.tree_util.tree_flatten_with_path(new_state)
+    paths_old = jax.tree_util.tree_flatten_with_path(old_state)[0]
+    old_leaves = [leaf for _p, leaf in paths_old]
+    layout = leaf_layout(paths_old)
+    idx_acc: dict[str, list] = {}
+    val_acc: dict[str, list] = {}
+    merged = []
+    changed_leaves = 0
+    elements = 0
+    for ((path, new), old, (cls, off)) in zip(paths_new, old_leaves,
+                                              layout):
+        if new is old:
+            merged.append(old)
+            continue
+        if (getattr(new, "shape", None) != old.shape
+                or new.dtype != old.dtype):
+            raise DeltaShapeError(
+                f"leaf {jax.tree_util.keystr(path)}: "
+                f"{getattr(new, 'shape', None)}/{new.dtype} != "
+                f"{old.shape}/{old.dtype}")
+        diff = new != old
+        if new.dtype.kind == "f":
+            # NaN-stable: an unset-sentinel NaN must not read as a
+            # changed element forever (same rule as the classic ship)
+            diff &= ~(np.isnan(new) & np.isnan(old))
+        flat = np.flatnonzero(diff)
+        if not len(flat):
+            merged.append(old)
+            continue
+        changed_leaves += 1
+        elements += len(flat)
+        idx_acc.setdefault(cls, []).append(
+            flat.astype(np.int32) + np.int32(off))
+        val_acc.setdefault(cls, []).append(new.ravel()[flat])
+        merged.append(new)
+    delta: dict = {"idx": {}, "val": {}}
+    buckets: dict[str, int] = {}
+    min_buckets = min_buckets or {}
+    for key, dtype_name in _groups(paths_old):
+        idx_parts = idx_acc.get(key, [])
+        n = int(sum(len(p) for p in idx_parts))
+        k = max(_bucket(n), int(min_buckets.get(key, 0)))
+        buckets[key] = k
+        idx = np.full((k,), -1, np.int32)
+        val = np.zeros((k,), np.dtype(dtype_name))
+        if n:
+            idx[:n] = np.concatenate(idx_parts)
+            val[:n] = np.concatenate(val_acc[key])
+        delta["idx"][key] = idx
+        delta["val"][key] = val
+    stats = {"leaves": changed_leaves, "elements": elements,
+             "bytes": delta_nbytes(delta), "buckets": buckets}
+    return delta, jax.tree_util.tree_unflatten(treedef, merged), stats
+
+
+def apply_delta(state, delta: dict):
+    """Scatter a packed journal delta into the device-resident state.
+
+    Pure and trace-safe — the fused cycle entry inlines it under
+    ``donate_argnums`` so the writes land in the donated snapshot
+    buffers.  Every leaf scans its class's whole segment table: entries
+    outside the leaf's ``[offset, offset + size)`` range (including the
+    ``idx == -1`` padding) rebase out of bounds and are dropped by the
+    scatter, so the per-leaf work is a fixed-shape masked scatter with
+    no dynamic shapes anywhere.
+    """
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    layout = leaf_layout(paths_leaves)
+    out = []
+    for (_path, leaf), (cls, off) in zip(paths_leaves, layout):
+        idx = delta["idx"][cls]
+        val = delta["val"][cls]
+        if idx.shape[0] == 0:
+            out.append(leaf)
+            continue
+        size = int(leaf.size)
+        local = idx - jnp.int32(off)
+        ok = (local >= 0) & (local < size)
+        # out-of-range (other leaves' entries + padding) → index `size`,
+        # dropped by mode="drop"; negative padding never wraps
+        local = jnp.where(ok, local, size)
+        flat = jnp.reshape(leaf, (-1,)).at[local].set(
+            val.astype(leaf.dtype), mode="drop")
+        out.append(jnp.reshape(flat, leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, out)

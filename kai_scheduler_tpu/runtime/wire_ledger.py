@@ -14,6 +14,13 @@ name, nbytes, dtype/shape, content fingerprint, and a *reason*:
 * ``full-build``     — ``build_snapshot``'s one-shot snapshot transfer;
 * ``journal-patch``  — the incremental snapshotter's changed-leaves
   ship (``state/incremental.py``), batched into ONE dispatch;
+* ``delta-apply``    — the kai-resident packed journal delta
+  (``ops/resident.py``): the only steady-state upload once the
+  snapshot lives on device; its buffers are **transient** (consumed by
+  the donated scatter-apply dispatch), so they are counted on the wire
+  but kept out of the device-residency gauge and the redundancy
+  compare (delta *indices* legitimately repeat cycle-to-cycle — the
+  redundancy invariant is about resident snapshot leaves);
 * ``fallback``       — the incremental engine rebuilt in full (cold
   start, structural change, feature pods, dirty-threshold, ...);
 * ``verify``         — the patched==fresh verifier's reference rebuild;
@@ -64,12 +71,13 @@ import numpy as np
 
 __all__ = [
     "TransferLedger", "LEDGER", "REASON_FULL_BUILD",
-    "REASON_JOURNAL_PATCH", "REASON_FALLBACK", "REASON_VERIFY",
-    "REASON_MESH_SHARD",
+    "REASON_JOURNAL_PATCH", "REASON_DELTA_APPLY", "REASON_FALLBACK",
+    "REASON_VERIFY", "REASON_MESH_SHARD",
 ]
 
 REASON_FULL_BUILD = "full-build"
 REASON_JOURNAL_PATCH = "journal-patch"
+REASON_DELTA_APPLY = "delta-apply"
 REASON_FALLBACK = "fallback"
 REASON_VERIFY = "verify"
 REASON_MESH_SHARD = "mesh-shard"
@@ -147,6 +155,15 @@ class TransferLedger:
         #: device-resident set (last upload per leaf key)
         self._resident: dict[tuple[str, str], tuple] = {}
         self._resident_bytes = 0
+        #: resident keys (re)uploaded in the open window — at roll
+        #: time, resident bytes NOT in this set were *reused* on device
+        #: without touching the wire (the kai-resident payoff gauge)
+        self._window_uploaded_keys: set[tuple[str, str]] = set()
+        #: cumulative accounted D2H readbacks (:meth:`device_get`) —
+        #: kept separate from the upload ``by_reason`` totals so upload
+        #: invariants (bytes == delta size) never absorb download bytes
+        self._downloads: dict[str, dict] = {}
+        self._window_downloads: dict[str, dict] = {}
         #: cumulative per-reason aggregates since process start
         self._totals: dict[str, dict] = {}
         #: ring/event bounds + fingerprint limit — immutable after init
@@ -175,7 +192,8 @@ class TransferLedger:
 
     def device_put(self, tree, sharding=None, *, reason: str,
                    site: str = "snapshot", replace_site: bool = False,
-                   leaf_names: list[str] | None = None):
+                   leaf_names: list[str] | None = None,
+                   transient: bool = False):
         """THE package choke point for ``jax.device_put`` (KAI071).
 
         Dispatches the whole ``tree`` in ONE ``jax.device_put`` call
@@ -191,6 +209,15 @@ class TransferLedger:
         redundancy tracking keys identically across full builds and
         patches.  Names must follow the tree's FLATTEN order (jax
         flattens dict keys SORTED, not in insertion order).
+
+        ``transient=True`` marks a consumable upload — a buffer a
+        donated dispatch eats in the same cycle (the kai-resident
+        packed delta).  Transient leaves count toward bytes on the
+        wire but are excluded from the device-residency gauge (they do
+        not outlive the dispatch, and counting them would double-book
+        the donated snapshot buffers they scatter into) and from the
+        redundancy compare (delta segments may legitimately repeat
+        content across cycles without any leaf being re-uploaded).
         """
         override = getattr(self._local, "reason", None)
         if override is not None:
@@ -211,8 +238,12 @@ class TransferLedger:
         for i, (path, leaf) in enumerate(leaves_p):
             name = (leaf_names[i] if leaf_names is not None
                     else jax.tree_util.keystr(path) or f"[{i}]")
+            # transient (donated-consumable) uploads skip the content
+            # fingerprint: they never enter the resident set or the
+            # redundancy compare, so hashing them is pure overhead
             staged.append((name, leaf, int(getattr(leaf, "nbytes", 0)),
-                           _fingerprint(leaf, limit)))
+                           None if transient
+                           else _fingerprint(leaf, limit)))
         agg = dict.fromkeys(_TOTAL_FIELDS, 0)
         agg["dispatches"] = 1
         with self._lock:
@@ -227,18 +258,21 @@ class TransferLedger:
                 key = (site, name)
                 if stale is not None:
                     stale.discard(key)
-                prev = self._resident.get(key)
-                redundant = (fp is not None and prev is not None
-                             and prev[0] == fp)
-                self._resident_bytes += nbytes - (
-                    prev[1] if prev is not None else 0)
-                self._resident[key] = (fp, nbytes)
+                redundant = False
+                if not transient:
+                    prev = self._resident.get(key)
+                    redundant = (fp is not None and prev is not None
+                                 and prev[0] == fp)
+                    self._resident_bytes += nbytes - (
+                        prev[1] if prev is not None else 0)
+                    self._resident[key] = (fp, nbytes)
+                    self._window_uploaded_keys.add(key)
                 agg["leaves"] += 1
                 agg["bytes"] += nbytes
                 if redundant:
                     agg["redundant_leaves"] += 1
                     agg["redundant_bytes"] += nbytes
-                if fp is None:
+                if fp is None and not transient:
                     agg["unfingerprinted_bytes"] += nbytes
                 if len(self._window_events) < self.max_events_per_cycle:
                     self._window_events.append(
@@ -247,6 +281,7 @@ class TransferLedger:
                     self._window_dropped += 1
             for key in sorted(stale or ()):
                 self._resident_bytes -= self._resident.pop(key)[1]
+                self._window_uploaded_keys.discard(key)
             self._window_peak = max(self._window_peak,
                                     self._resident_bytes)
             for dst in (self._window_totals.setdefault(
@@ -282,6 +317,31 @@ class TransferLedger:
         metrics.wire_resident_bytes.set(value=float(resident_bytes))
         metrics.wire_resident_buffers.set(value=float(resident_buffers))
 
+    def device_get(self, tree, *, reason: str, site: str = "snapshot"):
+        """Accounted batched device→host readback — the D2H counterpart
+        of :meth:`device_put` for the few legitimate bulk gathers
+        outside the packed commit (the kai-resident verify gather, the
+        rare repack-plan readback on resident cycles).  One
+        ``jax.device_get`` call for the whole tree; bytes are booked in
+        a separate ``downloads`` ledger so upload invariants (patched
+        bytes == delta size) never absorb readback traffic."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        out = jax.device_get(tree)
+        nbytes = sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
+        with self._lock:
+            for dst in (self._window_downloads, self._downloads):
+                t = dst.setdefault(reason, {"leaves": 0, "bytes": 0,
+                                            "dispatches": 0})
+                t["leaves"] += len(leaves)
+                t["bytes"] += nbytes
+                t["dispatches"] += 1
+        try:
+            from ..framework import metrics  # package-relative, lazy
+        except Exception:  # noqa: BLE001 — mirror must never fail a read
+            return out
+        metrics.wire_downloaded_bytes.inc(reason, by=float(nbytes))
+        return out
+
     def roll_cycle(self, cycle_id: int) -> dict:
         """Close the open window into an immutable ring entry and
         return the cycle summary (``CycleResult.wire``).  Called by the
@@ -294,9 +354,20 @@ class TransferLedger:
             events = tuple(self._window_events)
             dropped = self._window_dropped
             peak = max(self._window_peak, self._resident_bytes)
+            # kai-resident payoff gauge: resident bytes that stayed on
+            # device this cycle without touching the wire, vs bytes
+            # actually uploaded.  A steady resident cycle reads
+            # reused ≈ snapshot size, uploaded ≈ packed delta size.
+            reused = sum(
+                ent[1] for key, ent in self._resident.items()
+                if key not in self._window_uploaded_keys)
+            downloads = {r: dict(t) for r, t
+                         in sorted(self._window_downloads.items())}
             self._window_events = []
             self._window_dropped = 0
             self._window_totals = {}
+            self._window_downloads = {}
+            self._window_uploaded_keys = set()
             self._window_peak = self._resident_bytes
             resident_bytes = self._resident_bytes
             resident_buffers = len(self._resident)
@@ -307,9 +378,12 @@ class TransferLedger:
                 "resident_bytes": resident_bytes,
                 "resident_buffers": resident_buffers,
                 "peak_resident_bytes": peak,
+                "resident_reused_bytes": reused,
+                "downloads": downloads,
             }
             for field in _TOTAL_FIELDS:
                 summary[field] = sum(t[field] for t in by_reason.values())
+            summary["resident_uploaded_bytes"] = summary["bytes"]
             entry = dict(summary)
             entry["events"] = events
             self._ring.append(entry)
@@ -324,6 +398,13 @@ class TransferLedger:
             return
         metrics.wire_cycle_uploaded_bytes.observe(
             value=float(summary["bytes"]))
+        # kai-resident: reused-on-device vs uploaded bytes per cycle —
+        # the gauge pair ROADMAP-1's acceptance bar reads (reused ≈
+        # snapshot size, uploaded ≈ packed delta size in steady state)
+        metrics.wire_resident_reused_bytes.set(
+            value=float(summary["resident_reused_bytes"]))
+        metrics.wire_resident_uploaded_bytes.set(
+            value=float(summary["resident_uploaded_bytes"]))
 
     # -- reading -----------------------------------------------------------
 
@@ -333,6 +414,9 @@ class TransferLedger:
         with self._lock:
             return {"by_reason": {r: dict(t) for r, t
                                   in sorted(self._totals.items())},
+                    "downloads_by_reason": {
+                        r: dict(t)
+                        for r, t in sorted(self._downloads.items())},
                     "resident_bytes": self._resident_bytes,
                     "resident_buffers": len(self._resident)}
 
@@ -368,11 +452,14 @@ class TransferLedger:
                          "peak_bytes": max(self._window_peak,
                                            self._resident_bytes)}
             totals = {r: dict(t) for r, t in sorted(self._totals.items())}
+            downloads = {r: dict(t)
+                         for r, t in sorted(self._downloads.items())}
         return {
             "cycles": [dict(c, events=list(c["events"])) for c in ring],
             "window": window,
             "residency": residency,
-            "totals": {"by_reason": totals},
+            "totals": {"by_reason": totals,
+                       "downloads_by_reason": downloads},
         }
 
 
