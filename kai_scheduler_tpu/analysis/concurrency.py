@@ -175,6 +175,11 @@ class RaceReport:
     disciplines: dict[tuple[str, str], str]
     #: number of live (non-stale) inline annotations
     live_annotations: int = 0
+    #: every surface-class attribute access the interpretation
+    #: recorded — meta-tests assert coverage (a resolution regression
+    #: must fail loudly, not silently shrink the checked surface)
+    interp_accesses: list[AccessRecord] = dataclasses.field(
+        default_factory=list)
 
 
 def _expr_type(mod: ModuleInfo, node: ast.AST) -> str | None:
@@ -1019,7 +1024,8 @@ def analyze_package(graph: PackageGraph,
         for f, line, qual, msg in interp.blocking)
     live = _count_live_annotations(index, interp, declared_inline)
     return RaceReport(findings=sorted(set(findings)), roots=roots,
-                      disciplines=declared, live_annotations=live)
+                      disciplines=declared, live_annotations=live,
+                      interp_accesses=list(interp.accesses))
 
 
 def _surface_classes(index: _Index, roots: list[ThreadRoot],

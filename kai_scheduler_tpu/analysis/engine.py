@@ -115,6 +115,11 @@ F64_HOST_ALLOWLIST = frozenset({
     "kai_scheduler_tpu/runtime/usagedb.py",
     "kai_scheduler_tpu/state/cluster_state.py",
     "kai_scheduler_tpu/state/incremental.py",
+    # kai-intake admission sweep: bound checks need full double
+    # precision (float32's 64-unit ulp at the 1e9 cap would round
+    # out-of-range values ONTO the bound); host-only, nothing crosses
+    # to the device
+    "kai_scheduler_tpu/intake/apply.py",
 })
 
 

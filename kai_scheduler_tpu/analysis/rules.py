@@ -14,6 +14,8 @@ Code families (stable — suppressions and baselines reference them):
   ledger choke point)
 * ``KAI081``        donation discipline (host-side read of a buffer
   previously passed through a donated argnum — use-after-donate)
+* ``KAI091``        intake discipline (direct hub-journal mark writes
+  outside the journal's module and the kai-intake gate)
 
 "Jit region" is the transitive call graph grown from the package's
 ``jax.jit`` entry points (see ``callgraph.py``); host-only code is
@@ -810,6 +812,70 @@ def _donated_buffer_read(ctx: RuleCtx) -> Iterator[Finding]:
                     f"ignore donation).  Rebind the name from the "
                     f"call's outputs instead", qual)
                 break
+
+
+# ---------------------------------------------------------------------------
+# KAI091 — intake discipline
+
+#: the hub-journal write choke point: the journal's own module plus the
+#: kai-intake package (whose ``gate`` module owns the mark mapping and
+#: whose router/applier are the sanctioned bulk writers).  Everything
+#: else — hub mutators, binder write-backs, wire codecs, new
+#: subsystems — must mark through ``intake/gate.py``, so the
+#: storm-vs-sequential differential (one shared upsert/delete → mark
+#: mapping) can never silently fork as code grows.  Mirrors KAI071's
+#: device_put discipline.
+_JOURNAL_CHOKE_POINT = frozenset({
+    "kai_scheduler_tpu/state/incremental.py",
+})
+_JOURNAL_CHOKE_PREFIX = "kai_scheduler_tpu/intake/"
+
+#: the MutationJournal mark surface (state/incremental.py) — calling
+#: any of these on a journal object IS a hub-journal write
+_JOURNAL_MARK_METHODS = frozenset({
+    "mark_pod", "mark_pod_added", "mark_pod_removed", "mark_gang",
+    "mark_gang_added", "mark_node", "mark_structural", "mark_time",
+    "merge",
+})
+
+
+@rule(
+    "KAI091", "direct hub-journal mark outside the intake gate",
+    bad="""
+def evict(cluster, name):
+    cluster.journal.mark_pod(name)
+""",
+    good="""
+from kai_scheduler_tpu.intake import gate
+
+def evict(cluster, name):
+    gate.pod_touched(cluster.journal, name)
+""")
+def _raw_journal_mark(ctx: RuleCtx) -> Iterator[Finding]:
+    if (ctx.mod.relpath in _JOURNAL_CHOKE_POINT
+            or ctx.mod.relpath.startswith(_JOURNAL_CHOKE_PREFIX)):
+        return
+    _index_descendants(ctx)
+    for node in ast.walk(ctx.mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _JOURNAL_MARK_METHODS):
+            continue
+        # scope to journal receivers: `<x>.journal.mark_*` chains and
+        # names that smell like a journal — `merge` alone is far too
+        # generic to flag on arbitrary objects
+        base = _dotted(node.func.value)
+        if base is None or "journal" not in base.lower():
+            continue
+        yield ctx.finding(
+            "KAI091", node,
+            f".{node.func.attr}() writes the hub MutationJournal "
+            f"directly — route the mark through the kai-intake gate "
+            f"(intake/gate.py), the package's single journal-write "
+            f"choke point: one shared upsert/delete→mark mapping is "
+            f"what keeps the async-lane coalesce bit-identical to the "
+            f"sequential classic path (KAI091, mirrors KAI071)",
+            _in_function(ctx, node) or "")
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,7 @@ import dataclasses
 from typing import Protocol
 
 from ..apis import types as apis
+from ..intake import gate as _gate
 from ..runtime.cluster import Cluster
 
 
@@ -252,7 +253,7 @@ class Binder:
             if pod is None or pod.status in (apis.PodStatus.SUCCEEDED,
                                              apis.PodStatus.FAILED):
                 br.phase = "Failed"
-                cluster.journal.mark_pod(br.pod_name)
+                _gate.pod_touched(cluster.journal, br.pod_name)
                 result.failed.append(br.pod_name)
                 continue
             if pod.status == apis.PodStatus.RELEASING:
@@ -262,7 +263,7 @@ class Binder:
                 continue
             if pod.status != apis.PodStatus.PENDING:
                 br.phase = "Failed"
-                cluster.journal.mark_pod(br.pod_name)
+                _gate.pod_touched(cluster.journal, br.pod_name)
                 result.failed.append(br.pod_name)
                 continue
             done: list[BinderPlugin] = []
@@ -278,7 +279,7 @@ class Binder:
                 br.failures += 1
                 if br.failures > br.backoff_limit:
                     br.phase = "Failed"
-                    cluster.journal.mark_pod(br.pod_name)
+                    _gate.pod_touched(cluster.journal, br.pod_name)
                     result.failed.append(br.pod_name)
                 else:
                     result.retrying.append(br.pod_name)
@@ -286,6 +287,6 @@ class Binder:
             for plugin in self.plugins:
                 plugin.post_bind(cluster, pod, br)
             br.phase = "Succeeded"
-            cluster.journal.mark_pod(br.pod_name)
+            _gate.pod_touched(cluster.journal, br.pod_name)
             result.bound.append(br.pod_name)
         return result

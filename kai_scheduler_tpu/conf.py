@@ -166,6 +166,19 @@ def load_config(doc: dict | str | None,
                 "cooldownCycles", out.repack_cooldown)),
             repack_max_migrations=int(repack_doc.get(
                 "maxMigrations", out.repack_max_migrations)))
+    intake_doc = doc.get("intake") or {}
+    if intake_doc:
+        # kai-intake multi-lane mutation front end (intake/router.py):
+        # lane fan-out, per-lane bound, and the overflow policy the
+        # server's POST /intake route enforces
+        out = dataclasses.replace(
+            out,
+            intake_lanes=int(intake_doc.get("lanes", out.intake_lanes)),
+            intake_lane_capacity=int(intake_doc.get(
+                "laneCapacity", out.intake_lane_capacity)),
+            intake_policy=str(intake_doc.get(
+                "policy", out.intake_policy)),
+            intake_batch=int(intake_doc.get("batch", out.intake_batch)))
     if "actions" in doc:
         out = dataclasses.replace(out,
                                   actions=_parse_actions(doc["actions"]))
@@ -226,6 +239,12 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "triggerCycles": cfg.repack_trigger_cycles,
             "cooldownCycles": cfg.repack_cooldown,
             "maxMigrations": cfg.repack_max_migrations,
+        },
+        "intake": {
+            "lanes": cfg.intake_lanes,
+            "laneCapacity": cfg.intake_lane_capacity,
+            "policy": cfg.intake_policy,
+            "batch": cfg.intake_batch,
         },
         "incremental": cfg.incremental,
         "resident": cfg.resident,

@@ -247,6 +247,50 @@ repack_gangs_unblocked = registry.counter(
     "kai_repack_gangs_unblocked_total",
     "Target gangs that placed within the post-firing observation "
     "window after their repack migrations committed")
+# kai-intake multi-lane mutation front end (intake/router.py): cluster
+# deltas hash-shard by entity key into bounded lanes, drain workers
+# admission-check them in vectorized batches, and a cycle-boundary
+# coalesce merges the staged events into the hub journal — replacing
+# the per-mutation single-writer wall with explicit, metered
+# backpressure
+intake_accepted = registry.counter(
+    "kai_intake_accepted_total",
+    "Events accepted into an intake lane (queued for admission + "
+    "coalesce)")
+intake_shed = registry.counter(
+    "kai_intake_shed_total",
+    "Events shed by lane backpressure (the offered group exceeded the "
+    "lane bound; the whole group is refused atomically — HTTP 429, "
+    "nothing journaled)", label_names=("lane",))
+intake_rejected = registry.counter(
+    "kai_intake_rejected_total",
+    "Events rejected by the batched admission sweep (unknown "
+    "collection, malformed document, resource scalar non-finite / "
+    "negative / absurd)", label_names=("lane",))
+intake_coalesced = registry.counter(
+    "kai_intake_coalesced_total",
+    "Staged events merged into the hub journal at cycle-boundary "
+    "coalesce (global sequence order, bit-identical to the sequential "
+    "classic path)")
+intake_apply_errors = registry.counter(
+    "kai_intake_apply_errors_total",
+    "Admitted events the coalesce applier had to skip (doc passed the "
+    "door check but failed object construction) — skipped, not fatal: "
+    "one poisoned doc must never destroy other clients' accepted "
+    "events or fail the cycle")
+intake_sync_degrades = registry.counter(
+    "kai_intake_sync_degrades_total",
+    "Overflow requests that degraded to the synchronous path "
+    "(policy=sync: drain inline + flush a coalesce through the commit "
+    "lock, then retry)")
+intake_lane_depth = registry.gauge(
+    "kai_intake_lane_depth",
+    "Queued + staged events per lane (observed at coalesce)",
+    label_names=("lane",))
+intake_coalesce_seconds = registry.histogram(
+    "kai_intake_coalesce_seconds",
+    "Cycle-boundary coalesce latency (take staged + seq sort + "
+    "sequential apply + bulk journal merge)")
 
 
 def catalog() -> list[dict]:

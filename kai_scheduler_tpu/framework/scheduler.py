@@ -407,6 +407,23 @@ class SchedulerConfig:
     #: ``min(repack_max_migrations, VictimConfig.max_victim_pods)`` so
     #: repack can never out-migrate the victim machinery.  0 disables.
     repack_max_migrations: int = 64
+    #: kai-intake (intake/router.py): the server's async multi-lane
+    #: mutation front end — ``POST /intake`` hash-shards delta events
+    #: into this many bounded lanes (one drain worker each), admission
+    #: runs in vectorized batches, and the staged stream coalesces into
+    #: the hub journal at cycle boundaries under the commit lock
+    intake_lanes: int = 4
+    #: per-lane bound on queued + staged events; overflow sheds (429)
+    #: or degrades to sync per ``intake_policy``
+    intake_lane_capacity: int = 65536
+    #: lane-overflow policy: "shed" refuses the offered group atomically
+    #: (HTTP 429, nothing journaled), "sync" drains inline + flushes a
+    #: coalesce through the commit lock and retries (the classic
+    #: single-writer behavior as the pressure valve, never the steady
+    #: state)
+    intake_policy: str = "shed"
+    #: max events per worker drain round (the vectorized admission batch)
+    intake_batch: int = 512
 
 
 def apply_shard_args(session: SessionConfig,

@@ -34,6 +34,15 @@ Reference surfaces collapse into one stdlib HTTP server:
   (``ops/repack.py``): trigger knobs, live trigger state (consecutive
   high-fragmentation cycles, cooldown remaining), and the last
   firing's bounded migration plan.
+- ``GET /debug/intake`` — the kai-intake multi-lane mutation front end
+  (``intake/router.py``): per-lane queued/staged depth, accepted/shed/
+  rejected counters, recent admission rejections, coalesce totals.
+- ``POST /intake``      — queue a delta document through the async
+  lanes instead of applying it under the commit lock: hash-sharded by
+  entity key, admission-checked in vectorized batches, coalesced into
+  the hub journal at the next cycle boundary.  Lane overflow sheds
+  with 429 (atomically per lane group — nothing journaled) or
+  degrades to sync, per ``SchedulerConfig.intake_policy``.
 - ``GET /debug``        — machine-readable index of every debug
   surface with one-line descriptions and live query params, so
   operators stop grepping this file.
@@ -54,6 +63,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..intake import apply as intake_apply
+from ..intake.router import IntakeConfig, IntakeRouter
 from ..runtime import compile_watch, wire_ledger
 from ..runtime.cluster import Cluster
 from ..runtime.snapshot import dump_cluster, load_cluster
@@ -86,6 +97,11 @@ DEBUG_SURFACES = (
      "desc": ("kai-repack defragmentation solver: trigger knobs + live "
               "trigger state (frag streak, cooldown) and the last "
               "firing's bounded migration plan")},
+    {"path": "/debug/intake", "params": (),
+     "desc": ("kai-intake multi-lane mutation front end: per-lane "
+              "queued/staged depth, accepted/shed/rejected counters, "
+              "recent admission rejections, coalesce totals, worker "
+              "liveness")},
     {"path": "/debug/pprof", "params": (),
      "desc": ("one profiled cycle (cProfile): hottest host functions "
               "+ kai-trace phase breakdown")},
@@ -160,68 +176,16 @@ def apply_cluster_delta(cluster: Cluster, delta: dict) -> None:
     delta/incremental wire protocol: instead of shipping the full
     cluster document every cycle (tens of MB at 10k nodes × 50k pods),
     a sidecar PATCHes only what changed.  Collections accept
-    ``{collection}_upsert`` (object docs) and ``{collection}_delete``
-    (names); ``now`` advances the clock."""
-    from ..apis import types as apis
-    from ..runtime import snapshot as snap
-    defaults = {
-        "nodes": lambda: snap._to_jsonable(apis.Node(name="")),
-        "queues": lambda: snap._to_jsonable(apis.Queue(name="")),
-        "pod_groups": lambda: snap._to_jsonable(
-            apis.PodGroup(name="", queue="")),
-        "pods": lambda: snap._to_jsonable(apis.Pod(name="", group="")),
-        "bind_requests": lambda: snap._to_jsonable(
-            apis.BindRequest(pod_name="", selected_node="")),
-    }
-    defaults.update({
-        "resource_claims": lambda: snap._to_jsonable(
-            apis.ResourceClaim(name="")),
-        "device_classes": lambda: snap._to_jsonable(
-            apis.DeviceClass(name="")),
-        "volume_claims": lambda: snap._to_jsonable(
-            apis.PersistentVolumeClaim(name="")),
-        "storage_classes": lambda: snap._to_jsonable(
-            apis.StorageClass(name="")),
-    })
-    parsers = {
-        "nodes": (snap._node, cluster.nodes),
-        "queues": (snap._queue, cluster.queues),
-        "pod_groups": (snap._pod_group, cluster.pod_groups),
-        "pods": (snap._pod, cluster.pods),
-        "bind_requests": (snap._bind_request, cluster.bind_requests),
-        "resource_claims": (
-            lambda d: apis.ResourceClaim(**d), cluster.resource_claims),
-        "device_classes": (
-            lambda d: apis.DeviceClass(**d), cluster.device_classes),
-        "volume_claims": (
-            lambda d: apis.PersistentVolumeClaim(**d),
-            cluster.volume_claims),
-        "storage_classes": (
-            lambda d: apis.StorageClass(**d), cluster.storage_classes),
-    }
-    from ..wire.codec import _journal_delete, _journal_upsert
-    journal = cluster.journal
-    for coll, (parse, store) in parsers.items():
-        for doc in delta.get(f"{coll}_upsert", []):
-            # partial documents merge over the EXISTING object when the
-            # key is already stored (a delta only carries the fields
-            # that changed), over defaults for new objects
-            key0 = doc.get("name") or doc.get("pod_name")
-            if key0 in store:
-                full = snap._to_jsonable(store[key0])
-            else:
-                full = defaults[coll]()
-            full.update(doc)
-            obj = parse(full)
-            key = getattr(obj, "name", None) or obj.pod_name
-            _journal_upsert(journal, coll, key, obj, key in store)
-            store[key] = obj
-        for name in delta.get(f"{coll}_delete", []):
-            _journal_delete(journal, coll, name, name in store)
-            store.pop(name, None)
-    if "now" in delta:
-        cluster.now = float(delta["now"])
-        journal.mark_time()
+    ``{collection}_upsert`` (object docs, partial docs merge over the
+    stored object) and ``{collection}_delete`` (names); ``now``
+    advances the clock.
+
+    This is the CLASSIC synchronous path — it delegates to the same
+    decompose + apply pipeline the kai-intake router's coalesce replays
+    (``intake/apply.py``), which is what makes the async lanes'
+    storm-vs-sequential differential bar a shared-code identity rather
+    than a parallel reimplementation."""
+    intake_apply.apply_cluster_delta(cluster, delta)
 
 
 def run_cycle_doc(doc: dict, scheduler: Scheduler | None = None) -> dict:
@@ -263,6 +227,14 @@ class SchedulerServer:
     The cluster/scheduler pair handed to a running server is owned by
     it: driving ``run_once`` on the same objects from another thread
     bypasses this lock.
+
+    kai-intake (PR 12) shrinks what the lock serializes: mutations
+    posted to ``POST /intake`` shard into the router's bounded lanes
+    (their own locks), admission-check off the commit path, and touch
+    ``_state_lock`` only at the cycle-boundary ``coalesce`` inside
+    ``POST /cycle/stored``.  The classic ``POST /cluster/delta`` stays
+    the synchronous reference path (same applier, applied immediately
+    under the lock).
     """
 
     def __init__(self, cluster: Cluster, scheduler: Scheduler | None = None,
@@ -270,6 +242,18 @@ class SchedulerServer:
         self._state_lock = threading.Lock()
         self.cluster = cluster  # kai-race: guarded-by=_state_lock
         self.scheduler = scheduler or Scheduler()
+        # kai-intake multi-lane front end: lanes/capacity/policy come
+        # from the scheduler config (conf `intake.*` document keys).
+        # The sync_flush valve lets policy="sync" degrade an overflowing
+        # request to the classic behavior: quiesce the lanes and run a
+        # coalesce under the commit lock, then retry.
+        icfg = self.scheduler.config
+        self.intake = IntakeRouter(
+            IntakeConfig(lanes=icfg.intake_lanes,
+                         lane_capacity=icfg.intake_lane_capacity,
+                         policy=icfg.intake_policy,
+                         batch=icfg.intake_batch),
+            sync_flush=self._intake_flush)
         #: immutable per-cycle stats document (GET /healthz); handler
         #: threads swap in a fresh dict under _state_lock, readers take
         #: the current binding without it
@@ -318,9 +302,13 @@ class SchedulerServer:
                     self._send(payload)
                 elif self.path == "/healthz":
                     # _cycle_stats is swapped atomically (never mutated
-                    # in place), so this read needs no lock
+                    # in place), so this read needs no lock; the
+                    # kai-intake slice reads only lane/router locks —
+                    # a health scrape never blocks behind the commit
+                    # lock or a full intake lane
                     stats = outer._cycle_stats
-                    self._send({"ok": True, "last_cycle": stats})
+                    self._send({"ok": True, "last_cycle": stats,
+                                "intake": outer.intake.health()})
                 elif self.path.startswith("/debug/trace"):
                     # kai-trace flight recorder: the retained cycle ring
                     # as Chrome-trace JSON.  Only the scheduler HANDLE
@@ -388,6 +376,13 @@ class SchedulerServer:
                         "starvation_alarm_cycles":
                             sched.config.starvation_alarm_cycles,
                         "ok": bool(doc)})
+                elif self.path.startswith("/debug/intake"):
+                    # kai-intake lane document: per-lane depth/shed/
+                    # rejection stats + coalesce totals.  Computed from
+                    # the router's own per-lane and router locks ONLY —
+                    # never _state_lock — so a scrape can never block
+                    # behind a running cycle or a full intake lane.
+                    self._send(outer.intake.debug_doc())
                 elif self.path.startswith("/debug/repack"):
                     # kai-repack status: knobs + trigger state + the
                     # LAST firing's plan doc.  Same discipline as
@@ -482,6 +477,7 @@ class SchedulerServer:
                             self._send_pb(pb.CommitSet())
                         elif self.path == "/cycle/stored":
                             with outer._state_lock:
+                                outer.intake.coalesce(outer.cluster)
                                 result = outer.scheduler.run_once(
                                     outer.cluster)
                                 outer._record_cycle(result)
@@ -511,10 +507,38 @@ class SchedulerServer:
                         with outer._state_lock:
                             apply_cluster_delta(outer.cluster, doc)
                         self._send({"ok": True})
+                    elif self.path == "/intake":
+                        # kai-intake: queue the delta through the async
+                        # multi-lane front end instead of applying it
+                        # under the commit lock.  Parse + lane offers
+                        # touch NO server state lock; the staged events
+                        # coalesce into the hub at the next cycle
+                        # boundary.  A backpressured (shed) request
+                        # reports 429 with the per-request counts —
+                        # atomically refused per lane group, nothing
+                        # journaled.
+                        doc = json.loads(body.decode())
+                        # all-or-nothing at the HTTP boundary: a 429
+                        # means NOTHING was queued, so a client's
+                        # blind full retry can never double-apply a
+                        # partially accepted delta.  Counts only on
+                        # the wire — the shed ops echo is for
+                        # in-process retriers.
+                        out = outer.intake.submit_delta(
+                            doc, all_or_nothing=True)
+                        self._send({"accepted": out["accepted"],
+                                    "shed": out["shed"],
+                                    "total": out["total"]},
+                                   code=429 if out["shed"] else 200)
                     elif self.path == "/cycle/stored":
                         # run a cycle against the stored cluster: the
-                        # incremental sidecar protocol's execute step
+                        # incremental sidecar protocol's execute step.
+                        # Cycle boundary = the kai-intake coalesce
+                        # point: staged lane events merge into the hub
+                        # journal (global seq order) before the cycle
+                        # snapshots it.
                         with outer._state_lock:
+                            outer.intake.coalesce(outer.cluster)
                             result = outer.scheduler.run_once(
                                 outer.cluster)
                             outer._record_cycle(result)
@@ -581,10 +605,19 @@ class SchedulerServer:
                 }
         self._cycle_stats = stats
 
+    def _intake_flush(self) -> None:
+        """Degrade-to-sync valve (``intake_policy="sync"``): coalesce
+        everything staged into the stored cluster under the commit lock
+        so an overflowing lane empties.  Called by the router from the
+        submitting handler thread, which holds NO lane locks here."""
+        with self._state_lock:
+            self.intake.coalesce(self.cluster)
+
     def start(self) -> "SchedulerServer":
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        self.intake.start()
         if self.profiler is not None:
             self.profiler.start()
         return self
@@ -592,6 +625,7 @@ class SchedulerServer:
     def stop(self) -> None:
         if self.profiler is not None:
             self.profiler.stop()
+        self.intake.stop()
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)

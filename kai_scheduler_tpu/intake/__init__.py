@@ -1,0 +1,34 @@
+"""kai-intake: async, load-shedding, multi-lane mutation intake.
+
+Three modules:
+
+- :mod:`.gate` — the hub-journal write choke point (lint rule KAI091):
+  every ``MutationJournal`` mark outside ``state/incremental.py``
+  routes through it.  Dependency-free, imported eagerly so the hub's
+  own mutators (``runtime/cluster.py``) can use it without cycles.
+- :mod:`.apply` — delta decomposition + the single-event applier both
+  the classic synchronous path and the router's coalesce share (the
+  storm-vs-sequential differential bar holds by shared code, not by
+  parallel reimplementation), plus the vectorized admission sweep.
+- :mod:`.router` — :class:`IntakeRouter`: hash-sharded bounded lanes,
+  per-lane drain workers, batched NumPy admission, cycle-boundary
+  coalesce, shed/degrade backpressure.
+
+``IntakeRouter``/``IntakeConfig`` resolve lazily: ``.apply`` imports
+the snapshot codec, which imports the cluster hub, which imports
+``.gate`` — eager re-export here would close that loop.
+"""
+from . import gate  # noqa: F401  (dependency-free; the choke point)
+
+_LAZY = ("IntakeRouter", "IntakeConfig")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from . import router
+        return getattr(router, name)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

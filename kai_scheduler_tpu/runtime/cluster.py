@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 
 from ..apis import types as apis
+from ..intake import gate as _gate
 
 
 @dataclasses.dataclass
@@ -80,16 +81,16 @@ class Cluster:
         """Add a workload (PodGroup + its pods) — podgrouper output."""
         group.creation_timestamp = group.creation_timestamp or self.now
         if group.name in self.pod_groups:
-            self.journal.mark_gang(group.name)
+            _gate.gang_touched(self.journal, group.name)
         else:
-            self.journal.mark_gang_added(group.name)
+            _gate.gang_added(self.journal, group.name)
         self.pod_groups[group.name] = group
         for p in pods:
             p.creation_timestamp = p.creation_timestamp or self.now
             if p.name in self.pods:
-                self.journal.mark_pod(p.name)
+                _gate.pod_touched(self.journal, p.name)
             else:
-                self.journal.mark_pod_added(p.name)
+                _gate.pod_added(self.journal, p.name)
             self.pods[p.name] = p
 
     # -- views ------------------------------------------------------------
@@ -142,7 +143,7 @@ class Cluster:
     def create_bind_request(self, br: apis.BindRequest) -> None:
         self.bind_requests[br.pod_name] = br
         # a Pending BindRequest changes the pod's snapshot presentation
-        self.journal.mark_pod(br.pod_name)
+        _gate.pod_touched(self.journal, br.pod_name)
 
     def node_device_free(self, node_name: str) -> list[float]:
         """Free share per accel device on a node, from pods' recorded
@@ -209,11 +210,11 @@ class Cluster:
                 pod.accel_devices = fully[:k]
         pod.node = node_name
         pod.status = apis.PodStatus.BOUND
-        self.journal.mark_pod(pod_name)
+        _gate.pod_touched(self.journal, pod_name)
         group = self.pod_groups.get(pod.group)
         if group is not None and group.last_start_timestamp is None:
             group.last_start_timestamp = self.now
-            self.journal.mark_gang(group.name)
+            _gate.gang_touched(self.journal, group.name)
 
     def evict_pod(self, pod_name: str, restart: bool = False) -> None:
         """Eviction = delete pod; its resources become releasing until the
@@ -226,7 +227,7 @@ class Cluster:
         pod = self.pods.get(pod_name)
         if pod is not None:
             pod.status = apis.PodStatus.RELEASING
-            self.journal.mark_pod(pod_name)
+            _gate.pod_touched(self.journal, pod_name)
             if restart:
                 self.restarting.add(pod_name)
 
@@ -234,7 +235,7 @@ class Cluster:
         """Advance time: bound pods start running, releasing pods vanish
         (or restart as pending, if their controller recreates them)."""
         self.now += seconds
-        self.journal.mark_time()
+        _gate.time_advanced(self.journal)
         for name in list(self.pods):
             pod = self.pods[name]
             if pod.status == apis.PodStatus.RELEASING:
@@ -253,10 +254,10 @@ class Cluster:
                     pod.status = apis.PodStatus.PENDING
                     pod.node = None
                     pod.accel_devices = []
-                    self.journal.mark_pod(name)
+                    _gate.pod_touched(self.journal, name)
                 else:
                     del self.pods[name]
-                    self.journal.mark_pod_removed(name)
+                    _gate.pod_removed(self.journal, name)
             elif pod.status == apis.PodStatus.BOUND:
                 pod.status = apis.PodStatus.RUNNING
-                self.journal.mark_pod(name)
+                _gate.pod_touched(self.journal, name)

@@ -212,16 +212,16 @@ class MutationJournal:
 
     # -- marks ------------------------------------------------------------
 
-    def mark_pod(self, name: str) -> None:
-        with self._lock:
-            self.generation += 1
-            for c in self._each():
+    def _apply_mark(self, kind: str, name: str) -> None:
+        """One mark's cursor fan-out — caller holds ``self._lock``.
+        The single implementation behind both the per-mark methods and
+        the kai-intake bulk :meth:`merge`, so a coalesced lane batch can
+        never drift from the sequential mark semantics."""
+        self.generation += 1
+        for c in self._each():
+            if kind == "pod":
                 c.pods_dirty.add(name)
-
-    def mark_pod_added(self, name: str) -> None:
-        with self._lock:
-            self.generation += 1
-            for c in self._each():
+            elif kind == "pod_added":
                 if name not in c.pods_removed and name not in c.pods_dirty:
                     c.pods_added.append(name)
                 else:
@@ -229,42 +229,70 @@ class MutationJournal:
                     # position in the dict may have moved — too subtle to
                     # patch, let the sweep/full rebuild sort it out
                     c.structural.append("pod-readded")
+            elif kind == "pod_removed":
+                c.pods_removed.add(name)
+            elif kind == "gang":
+                c.gangs_dirty.add(name)
+            elif kind == "gang_added":
+                c.gangs_added.append(name)
+            elif kind == "node":
+                c.nodes_dirty.add(name)
+            elif kind == "structural":
+                c.structural.append(name)
+            elif kind == "time":
+                c.time_dirty = True
+            else:
+                raise ValueError(f"unknown journal mark kind {kind!r}")
+
+    def mark_pod(self, name: str) -> None:
+        with self._lock:
+            self._apply_mark("pod", name)
+
+    def mark_pod_added(self, name: str) -> None:
+        with self._lock:
+            self._apply_mark("pod_added", name)
 
     def mark_pod_removed(self, name: str) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.pods_removed.add(name)
+            self._apply_mark("pod_removed", name)
 
     def mark_gang(self, name: str) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.gangs_dirty.add(name)
+            self._apply_mark("gang", name)
 
     def mark_gang_added(self, name: str) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.gangs_added.append(name)
+            self._apply_mark("gang_added", name)
 
     def mark_node(self, name: str) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.nodes_dirty.add(name)
+            self._apply_mark("node", name)
 
     def mark_structural(self, reason: str) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.structural.append(reason)
+            self._apply_mark("structural", reason)
 
     def mark_time(self) -> None:
         with self._lock:
-            self.generation += 1
-            for c in self._each():
-                c.time_dirty = True
+            self._apply_mark("time", "")
+
+    def merge(self, marks) -> None:
+        """Replay an ordered batch of ``(kind, name)`` mark operations
+        under ONE lock acquisition — the kai-intake ``coalesce()``
+        step's bulk merge of per-lane staged marks into the hub journal
+        (``intake/router.py``).
+
+        Event-for-event identical to calling the individual ``mark_*``
+        methods in the same order: same per-cursor set/list mutations
+        (including the pod-readded structural escalation, which is
+        order-sensitive) and the same generation count.  Only the lock
+        traffic is batched, so a 1M-event storm pays one acquisition
+        per coalesce instead of one per mark."""
+        if not marks:
+            return
+        with self._lock:
+            for kind, name in marks:
+                self._apply_mark(kind, name)
 
 
 # ---------------------------------------------------------------------------

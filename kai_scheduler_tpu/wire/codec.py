@@ -21,6 +21,7 @@ import enum
 import functools
 
 from ..apis import types as apis
+from ..intake import gate
 from ..runtime.cluster import Cluster
 from . import sidecar_pb2 as pb
 
@@ -184,59 +185,35 @@ def cluster_from_msg(doc: "pb.ClusterDoc") -> Cluster:
     return cluster
 
 
-def _journal_upsert(journal, attr: str, key: str, obj, existed: bool) -> None:
-    """Route a wire upsert into the cluster's mutation journal (the
-    incremental snapshotter's change feed, state/incremental.py)."""
-    if attr == "pods":
-        journal.mark_pod(key) if existed else journal.mark_pod_added(key)
-    elif attr == "pod_groups":
-        journal.mark_gang(key) if existed else journal.mark_gang_added(key)
-    elif attr == "bind_requests":
-        journal.mark_pod(obj.pod_name)
-    elif attr == "nodes":
-        # node rows anchor vocabularies/masks/device tables — dirty
-        # nodes force a full snapshot rebuild either way
-        journal.mark_node(key) if existed else \
-            journal.mark_structural("node-added")
-    elif attr == "queues":
-        if not existed:
-            journal.mark_structural("queue-added")
-        # field updates on an existing queue re-encode every refresh
-    else:
-        journal.mark_structural(f"{attr}-upsert")
-
-
-def _journal_delete(journal, attr: str, name: str, existed: bool) -> None:
-    if not existed:
-        return
-    if attr == "pods":
-        journal.mark_pod_removed(name)
-    elif attr == "bind_requests":
-        journal.mark_pod(name)
-    else:
-        journal.mark_structural(f"{attr}-delete")
-
-
 def apply_delta_msg(cluster: Cluster, delta: "pb.ClusterDelta") -> None:
     """Apply a proto delta: upserts carry COMPLETE objects (proto3 has
     no partial-field presence for scalars; the JSON wire keeps the
     partial-merge form), deletes are names.  Every change is recorded in
-    the cluster's mutation journal so the incremental snapshotter
-    refreshes only what the delta touched."""
+    the cluster's mutation journal — marks flow through the kai-intake
+    gate and bulk-merge per delta (one journal lock acquisition),
+    exactly the coalesce path's discipline."""
     journal = cluster.journal
-    for pb_field, attr in _COLLECTIONS:
-        store = getattr(cluster, attr)
-        for m in getattr(delta, f"{pb_field}_upsert"):
-            obj = from_msg(m)
-            key = getattr(obj, "name", None) or obj.pod_name
-            _journal_upsert(journal, attr, key, obj, key in store)
-            store[key] = obj
-        for name in getattr(delta, f"{pb_field}_delete"):
-            _journal_delete(journal, attr, name, name in store)
-            store.pop(name, None)
-    if delta.HasField("now"):
-        cluster.now = delta.now
-        journal.mark_time()
+    marks: list = []
+    try:
+        for pb_field, attr in _COLLECTIONS:
+            store = getattr(cluster, attr)
+            for m in getattr(delta, f"{pb_field}_upsert"):
+                obj = from_msg(m)
+                key = getattr(obj, "name", None) or obj.pod_name
+                gate.upsert_marks(attr, key, obj, key in store, marks)
+                store[key] = obj
+            for name in getattr(delta, f"{pb_field}_delete"):
+                gate.delete_marks(attr, name, name in store, marks)
+                store.pop(name, None)
+        if delta.HasField("now"):
+            cluster.now = delta.now
+            marks.append(("time", ""))
+    finally:
+        # merge even when a later message raises mid-delta (an unknown
+        # enum value, a malformed doc): every store mutation that DID
+        # apply must reach the journal or the incremental snapshotter
+        # serves a silently stale patch
+        gate.merge_marks(journal, marks)
 
 
 def commit_to_msg(result) -> "pb.CommitSet":

@@ -241,12 +241,34 @@ def test_known_thread_roots_discovered(race_report):
             "kai_scheduler_tpu/framework/server.py::"
             "SchedulerServer.__init__.Handler.do_GET",
             "kai_scheduler_tpu/framework/server.py::"
-            "SchedulerServer.__init__.Handler.do_POST"):
+            "SchedulerServer.__init__.Handler.do_POST",
+            "kai_scheduler_tpu/intake/router.py::"
+            "IntakeRouter._worker"):
         assert expected in discovered, (expected, sorted(discovered))
     # handler threads are per-request: multi-instance conflicts count
     multi = {r.root_id for r in report.roots if r.multi}
     assert any("do_GET" in r for r in multi)
     assert any("_worker" in r for r in multi)
+    # the kai-intake worker pool spawns one drain thread per lane — it
+    # must register as multi-instance or lane races check nothing
+    assert ("kai_scheduler_tpu/intake/router.py::IntakeRouter._worker"
+            in multi)
+
+
+def test_race_pass_sees_intake_lane_discipline(race_report):
+    """Detection power for the PR-12 surface: the pass must actually
+    OBSERVE _Lane state shared between the drain-worker root and
+    handler/coalesce contexts under the lane lock — if type resolution
+    of the lane helpers regresses, the lane annotations go stale and
+    the race rules silently stop covering the intake path."""
+    recs = [r for r in race_report.interp_accesses
+            if r.cls == "_Lane" and r.attr in ("queued", "staged")]
+    roots = {r.root for r in recs}
+    assert any("IntakeRouter._worker" in r for r in roots), roots
+    assert len(roots) >= 2, roots
+    assert all(("_Lane", "_lock") in r.held for r in recs), [
+        (r.function, r.line) for r in recs if ("_Lane", "_lock")
+        not in r.held]
 
 
 def test_guarded_by_annotations_are_live(race_report):
@@ -272,10 +294,10 @@ def test_race_pass_catches_dropped_journal_lock():
         src = mod.source.replace(
             "    def mark_time(self) -> None:\n"
             "        with self._lock:\n"
-            "            self.generation += 1",
+            "            self._apply_mark(\"time\", \"\")",
             "    def mark_time(self) -> None:\n"
             "        if True:\n"
-            "            self.generation += 1")
+            "            self._apply_mark(\"time\", \"\")")
         assert src != mod.source, "mark_time shape changed — update test"
         graph.modules[name] = ModuleInfo(
             relpath=mod.relpath, modname=mod.modname,
