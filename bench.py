@@ -460,6 +460,19 @@ def bench_preempt_many_queues(iters: int) -> dict:
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
 
 
+def _cost_model_peak_mb(sched) -> float | None:
+    """kai-cost's peak-live-bytes model for the fused entry, traced at
+    the scheduler's CURRENT snapshot shapes (analysis/costmodel.py) —
+    a pure re-trace, no compile/dispatch; None when no snapshot has
+    been built yet."""
+    from kai_scheduler_tpu.analysis import costmodel
+    snap = getattr(sched, "_snapshotter", None)
+    state = getattr(snap, "_dev", None) if snap is not None else None
+    if state is None:
+        return None
+    return costmodel.peak_mb_for_state(state).get("fused_pipeline")
+
+
 def _churn_cluster(cluster, rng, frac: float,
                    num_nodes: int = 10_000) -> None:
     """Journaled churn (evict half / rebind half / tick) through the
@@ -658,6 +671,13 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
             "redundant_patch": round(
                 float(np.mean([w[3] for w in wires]))),
         },
+        # kai-cost (analysis/costmodel.py): the fused entry's
+        # liveness-model peak-live-bytes traced AT this bench shape —
+        # the model-side HBM watermark printed beside the measured
+        # wire/phase columns (BENCH_r08+; the tier-1 cross-validation
+        # test pins the model's traffic ranking against measured
+        # dispatch ordering at canonical shapes)
+        "cost_model_peak_mb": _cost_model_peak_mb(sched),
         # kai-pulse rides every cycle here (analytics_every=1 default):
         # host dispatch cost of the analytics pass + the BENCH_r06+
         # cluster-health tracking columns from the last cycle

@@ -26,6 +26,14 @@ This package machine-checks those invariants in two layers:
   sampler, the mutation journal).  ``KAI1xx`` codes, inline
   ``# kai-race: guarded-by=`` annotations, and the checked-in
   ``guarded_by.json`` audit map.  Pure AST, part of the lint layer.
+* **Layer 4 — kai-cost** (``costmodel``): a static dataflow audit
+  over the same per-entry jaxpr walk the probe uses — def/last-use
+  liveness for peak-live-bytes (sub-jaxprs worst-case-resident), a
+  per-primitive FLOPs/traffic cost table, the ``KAI201`` broadcast-
+  blowup and ``KAI202`` donation-effectiveness checks, per-entry
+  budgets in ``cost_baseline.json``, and a scaling mode that fits the
+  peak-memory growth exponent over the node axis (the mesh-sharding
+  go/no-go signal).
 
 CLI: ``python -m kai_scheduler_tpu.analysis`` (see ``__main__``).
 Suppression syntax: ``# kai-lint: disable=KAI001`` (own line → next

@@ -1,21 +1,26 @@
 """``python -m kai_scheduler_tpu.analysis`` — the kai-lint CLI.
 
 Default run: layer-1 AST lint over the package (the KAI0xx trace-safety
-rules plus the KAI1xx kai-race concurrency pass) and the layer-2 jaxpr
-probe.  Exit status is nonzero on any non-baselined finding, so the
-command doubles as the CI gate (``scripts/lint.py`` wraps the
+rules plus the KAI1xx kai-race concurrency pass), the layer-2 jaxpr
+probe, and the layer-4 kai-cost audit (one shared jaxpr walk feeds
+probe and cost).  Exit status is nonzero on any non-baselined finding,
+so the command doubles as the CI gate (``scripts/lint.py`` wraps the
 lint-only fast path for pre-commit).
 
-    python -m kai_scheduler_tpu.analysis              # lint + probe
+    python -m kai_scheduler_tpu.analysis              # lint + probe + cost
     python -m kai_scheduler_tpu.analysis --no-probe   # AST lint only
     python -m kai_scheduler_tpu.analysis --race       # kai-race only
+    python -m kai_scheduler_tpu.analysis --cost       # kai-cost only
+    python -m kai_scheduler_tpu.analysis --cost --scaling   # + N-growth fit
     python -m kai_scheduler_tpu.analysis --json       # machine output
     python -m kai_scheduler_tpu.analysis --list-rules
     python -m kai_scheduler_tpu.analysis --probe --update-baseline
+    python -m kai_scheduler_tpu.analysis --update-baseline  # BOTH baselines
 """
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -43,10 +48,22 @@ def main(argv: list[str] | None = None) -> int:
     mode.add_argument("--race", action="store_true",
                       help="kai-race concurrency pass only (KAI1xx; "
                            "jax-free)")
+    mode.add_argument("--cost", action="store_true",
+                      help="kai-cost jaxpr dataflow audit only "
+                           "(KAI2xx: liveness peak-memory, FLOPs, "
+                           "traffic, blowup, donation)")
     ap.add_argument("--ops", default=None,
-                    help="comma-separated op names for the probe")
+                    help="comma-separated op names for the probe/cost "
+                         "stages")
+    ap.add_argument("--scaling", action="store_true",
+                    help="kai-cost scaling mode: re-trace key entries "
+                         "at 2-3 node widths and fit the peak-memory "
+                         "growth exponent (reported, never a failure)")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="rewrite the probe stats in baseline.json")
+                    help="rewrite the measured stats in baseline.json "
+                         "(probe stage) and cost_baseline.json (cost "
+                         "stage) — a default full run refreshes both "
+                         "in one invocation, together or not at all")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -63,7 +80,27 @@ def main(argv: list[str] | None = None) -> int:
     out: dict = {"findings": [], "probe": []}
     failed = False
 
-    if not args.probe:
+    #: stage selection — default (no mode flag) runs lint + probe +
+    #: cost; each mode flag narrows to its own stage
+    run_probe_stage = not (args.no_probe or args.cost or args.race)
+    run_cost_stage = args.cost or not (args.no_probe or args.probe
+                                       or args.race)
+
+    if args.scaling and not run_cost_stage:
+        # a mode that skips the cost stage would silently drop the
+        # exponent report — a clean exit with no cost-scaling output
+        # reads as "nothing super-linear"
+        ap.error("--scaling requires the kai-cost stage (drop the "
+                 "mode flag, or use --cost)")
+    if args.select and any(c.startswith("KAI2")
+                           for c in args.select.split(",")):
+        # KAI2xx are program-level checks (costmodel.py), not engine
+        # rules: the lint select filter would match nothing and print
+        # a FALSE "0 findings" clean bill
+        ap.error("KAI2xx rules are jaxpr-level — run them via --cost "
+                 "(they are not --select-able lint rules)")
+
+    if not args.probe and not args.cost:
         baseline = (load_baseline(baseline_path)
                     if os.path.exists(baseline_path) else [])
         select = (args.select.split(",") if args.select else None)
@@ -114,24 +151,43 @@ def main(argv: list[str] | None = None) -> int:
             print()
         return 1 if failed else 0
 
-    if not args.no_probe:
+    names = args.ops.split(",") if args.ops else None
+    shared_traces = None
+    if run_probe_stage and run_cost_stage:
+        # ONE shared per-entry jaxpr walk feeds both layers — tracing
+        # the fused entries costs seconds each, never pay it twice
+        from .trace_probe import trace_entries
+        shared_traces = trace_entries(names)
+
+    #: joint-refresh bookkeeping: when BOTH stages run with
+    #: --update-baseline, the two files rewrite together or not at all
+    #: (a half-refresh would absorb cost growth caused by the very
+    #: change the probe blocked on, or vice versa)
+    probe_update_ok = None      # None = probe stage ran no update
+    probe_reports = None
+
+    if run_probe_stage:
         from .trace_probe import (check_against_baseline,
                                   check_invariants, load_stats_baseline,
                                   run_probe, update_baseline)
-        reports = run_probe(args.ops.split(",") if args.ops else None)
+        reports = run_probe(names, traces=shared_traces)
         if args.update_baseline:
             # the baseline only absorbs eqn/const stats; callbacks,
             # f64, and cache misses have no legitimate new value and
             # still fail (and block the rewrite) here
             problems = check_invariants(reports)
+            probe_update_ok = not problems
             if problems:
                 if not args.as_json:
                     print("probe baseline NOT updated — invariant "
                           "failures first:")
-            else:
+            elif not run_cost_stage:
                 update_baseline(reports, baseline_path)
                 if not args.as_json:
                     print(f"probe baseline updated: {baseline_path}")
+            else:
+                # deferred until the cost stage verifies donations
+                probe_reports = reports
         else:
             stats = (load_stats_baseline(baseline_path)
                      if os.path.exists(baseline_path) else {})
@@ -148,6 +204,87 @@ def main(argv: list[str] | None = None) -> int:
             for p in problems:
                 print(f"PROBE FAIL: {p}")
         failed |= bool(problems)
+
+    if run_cost_stage:
+        from . import costmodel
+        cost_path = costmodel.COST_BASELINE_PATH
+        cost_base = (costmodel.load_cost_baseline(cost_path)
+                     if os.path.exists(cost_path) else {})
+        reports = costmodel.run_cost(
+            names, traces=shared_traces,
+            baseline=cost_base.get("entries", {}))
+        findings = costmodel.cost_findings(reports, cost_base)
+        if args.update_baseline:
+            # stats (peak/FLOPs/traffic/blowup ratios) are absorbed;
+            # KAI202 donation failures — including an UNVERIFIABLE
+            # donation check — have no legitimate new value, so they
+            # block the rewrite, exactly like probe invariants
+            problems = costmodel.unverifiable_donations(reports)
+            kai202 = [f for f in findings if f.code == "KAI202"]
+            if kai202 or problems:
+                # keep EVERY finding visible (a KAI201 riding along is
+                # neither absorbed nor silently dropped), and hold the
+                # deferred probe write back too — joint or nothing
+                if not args.as_json:
+                    print("cost baseline NOT updated — donation "
+                          "failures first:")
+                    if probe_update_ok:
+                        print("probe baseline NOT updated — cost "
+                              "stage blocked the joint refresh")
+            elif probe_update_ok is False:
+                if not args.as_json:
+                    print("cost baseline NOT updated — probe "
+                          "invariant failures blocked the joint "
+                          "refresh")
+            else:
+                costmodel.update_cost_baseline(reports, cost_path)
+                findings = []
+                if not args.as_json:
+                    print(f"cost baseline updated: {cost_path}")
+                if probe_update_ok:
+                    from .trace_probe import update_baseline
+                    update_baseline(probe_reports, baseline_path)
+                    if not args.as_json:
+                        print(f"probe baseline updated: "
+                              f"{baseline_path}")
+        else:
+            problems = costmodel.check_against_cost_baseline(
+                reports, cost_base, full_coverage=not args.ops)
+        scaling = (costmodel.scaling_report() if args.scaling
+                   else None)
+        out["cost"] = [dataclasses.asdict(r) for r in reports]
+        out["cost_problems"] = problems
+        out["cost_findings"] = [f.__dict__ for f in findings]
+        if scaling is not None:
+            out["cost_scaling"] = scaling
+        if not args.as_json:
+            for r in reports:
+                extra = ""
+                if r.unknown_prims:
+                    extra += (f", {sum(r.unknown_prims.values())} "
+                              f"bytes-only eqns")
+                if r.donation is not None:
+                    extra += (f", donation "
+                              f"{r.donation['compiled_aliased']}"
+                              f"/{r.donation['donated_leaves']} "
+                              f"aliased")
+                print(f"cost {r.name}: peak "
+                      f"{r.peak_live_bytes / 1e6:.2f}MB, "
+                      f"{r.flops / 1e6:.2f} MFLOP, traffic "
+                      f"{r.traffic_bytes / 1e6:.2f}MB, blowup "
+                      f"{r.max_blowup}x{extra}")
+            if scaling is not None:
+                for name, row in sorted(scaling["entries"].items()):
+                    flag = ("  ** SUPER-LINEAR **"
+                            if row["superlinear"] else "")
+                    print(f"cost-scaling {name}: peak exponent "
+                          f"{row['exponent']} over nodes "
+                          f"{scaling['node_counts']}{flag}")
+            for f in findings:
+                print(f.render())
+            for p in problems:
+                print(f"COST FAIL: {p}")
+        failed |= bool(problems) or bool(findings)
 
     if args.as_json:
         json.dump(out, sys.stdout, indent=2, default=str)

@@ -1,0 +1,40 @@
+"""Shared budget/tolerance math for the baseline-diffed analysis layers.
+
+Both the jaxpr probe (``trace_probe.py``: eqn counts, const bytes) and
+the kai-cost auditor (``costmodel.py``: peak live bytes, FLOPs, memory
+traffic) compare per-entry measurements against checked-in baselines
+with *tolerance headroom* — a relative growth allowance plus an
+absolute slack floor so tiny baselines don't fail on ±1 jitter.  The
+formula was open-coded twice before PR 14; this module is the single
+implementation both layers call, so the two baseline families can
+never drift apart in how "allowed" is computed.
+"""
+from __future__ import annotations
+
+
+def allowed_max(base: int | float, *, tolerance: float,
+                slack: int | float = 0) -> int:
+    """The largest measured value that still passes against ``base``:
+    ``int(base * (1 + tolerance)) + slack``.
+
+    ``int()`` truncates *before* adding slack — pinned by the probe
+    tests' historical eqn/const budget values; keep it that way.
+    """
+    return int(base * (1 + tolerance)) + int(slack)
+
+
+def budget_problem(entry: str, metric: str, value: int | float,
+                   base: int | float, *, tolerance: float,
+                   slack: int | float = 0, unit: str = "",
+                   hint: str = "") -> str | None:
+    """One human-readable regression message, or ``None`` when the
+    value fits the budget.  Shared renderer so probe and cost failures
+    read the same way in CLI/test output."""
+    limit = allowed_max(base, tolerance=tolerance, slack=slack)
+    if value <= limit:
+        return None
+    msg = (f"{entry}: {metric} grew to {value}{unit} "
+           f"(baseline {base}{unit}, allowed {limit}{unit})")
+    if hint:
+        msg += f" — {hint}"
+    return msg

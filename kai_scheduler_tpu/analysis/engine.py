@@ -75,11 +75,28 @@ def rule(code: str, title: str, *, bad: str = "", good: str = ""):
     return deco
 
 
+#: program-level (jaxpr) rule codes — the checks live in
+#: ``costmodel.py`` (layer 4, needs jax) but the catalog must stay
+#: jax-free for ``--list-rules`` and ``scripts/lint.py``; their
+#: fixtures are jax functions exercised by ``tests/test_costmodel.py``,
+#: not AST snippets, so they are NOT engine ``Rule`` entries
+PROGRAM_RULES = {
+    "KAI201": "intermediate aval exceeds blowup_factor × the entry's "
+              "largest input (broadcast blowup, jaxpr-level)",
+    "KAI202": "donated input leaf not aliased to any output in the "
+              "compiled executable (ineffective donation, "
+              "jaxpr-level)",
+}
+
+
 def rule_catalog() -> dict[str, str]:
-    """code -> title, for --list-rules and the docs."""
+    """code -> title, for --list-rules and the docs (AST rules plus
+    the program-level KAI2xx family)."""
     from . import concurrency as _conc  # noqa: F401  (registers on import)
     from . import rules as _rules  # noqa: F401  (registers on import)
-    return {c: RULES[c].title for c in sorted(RULES)}
+    out = {c: RULES[c].title for c in sorted(RULES)}
+    out.update(PROGRAM_RULES)
+    return dict(sorted(out.items()))
 
 
 @dataclasses.dataclass

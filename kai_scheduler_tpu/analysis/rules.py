@@ -16,6 +16,15 @@ Code families (stable — suppressions and baselines reference them):
   previously passed through a donated argnum — use-after-donate)
 * ``KAI091``        intake discipline (direct hub-journal mark writes
   outside the journal's module and the kai-intake gate)
+* ``KAI2xx``        kai-cost program-level family (``costmodel.py``,
+  catalog in ``engine.PROGRAM_RULES``): KAI201 broadcast blowup — an
+  intermediate aval exceeding ``blowup_factor ×`` the entry's largest
+  input; KAI202 ineffective donation — a donated input leaf the
+  compiled executable did not alias to any output.  These judge the
+  traced *program*, not source: their fixtures are jax functions
+  (``tests/test_costmodel.py``), their findings ride the engine's
+  count-based baseline rows (``cost_baseline.json``), and inline
+  source suppressions do not apply.
 
 "Jit region" is the transitive call graph grown from the package's
 ``jax.jit`` entry points (see ``callgraph.py``); host-only code is

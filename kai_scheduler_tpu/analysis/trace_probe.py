@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import budgets
 from ..framework.scheduler import (_fused_pipeline, _resident_cycle,
                                    resident_cycle, run_actions,
                                    stale_eviction_jit)
@@ -104,12 +105,14 @@ class OpReport:
     cache_hit: bool | None      # None = wrapper exposes no cache probe
 
 
-def _canonical_env(now: float):
+def _canonical_env(now: float, *, num_nodes: int = 8):
     """A small canonical cluster at production-padded shapes: running
     pods (victim paths need prey), a pending backlog, a 2-level
-    topology, and a 2-deep queue hierarchy."""
+    topology, and a 2-deep queue hierarchy.  ``num_nodes`` widens the
+    node axis only (the kai-cost scaling mode re-traces key entries at
+    2-3 padded node widths to fit the peak-memory growth exponent)."""
     nodes, queues, groups, pods, topo = make_cluster(
-        num_nodes=8, num_gangs=8, tasks_per_gang=2,
+        num_nodes=num_nodes, num_gangs=8, tasks_per_gang=2,
         running_fraction=0.5, partition_queues_by_running=True,
         topology_levels=(2, 2), priority_spread=3,
         pending_priority_boost=2)
@@ -137,6 +140,15 @@ def _registry() -> list[ProbeSpec]:
                "stalegangeviction")
 
     def fair_share(state):
+        if isinstance(jax.tree_util.tree_leaves(state)[0],
+                      jax.ShapeDtypeStruct):
+            # abstract env (kai-cost model-only re-trace, e.g. the
+            # bench's cost_model_peak_mb column at 10k×50k): compute
+            # the fair-share AVAL without compiling or dispatching the
+            # standalone jit at this shape
+            return jax.eval_shape(
+                functools.partial(drf.set_fair_share, num_levels=nl),
+                state, k_value=jnp.float32(0.0))
         return _set_fair_share_jit(state, num_levels=nl,
                                    k_value=jnp.float32(0.0))
 
@@ -281,6 +293,19 @@ def registered_ops() -> list[str]:
 # ---------------------------------------------------------------------------
 # jaxpr walking
 
+def eqn_sub_jaxprs(eqn) -> list:
+    """Sub-jaxprs nested in an eqn's params — THE structural scan for
+    every consumer of a walked entry (this walk and the kai-cost
+    liveness sweep in ``costmodel.py``), so the layers can never
+    disagree on nesting."""
+    subs = []
+    for p in eqn.params.values():
+        for x in (p if isinstance(p, (tuple, list)) else (p,)):
+            if hasattr(x, "eqns") or hasattr(x, "jaxpr"):
+                subs.append(x)
+    return subs
+
+
 def _walk_jaxpr(jaxpr, eqns, prims, avals, consts):
     """Recursively visit eqns/sub-jaxprs of a (Closed)Jaxpr."""
     inner = getattr(jaxpr, "jaxpr", jaxpr)
@@ -297,10 +322,56 @@ def _walk_jaxpr(jaxpr, eqns, prims, avals, consts):
             aval = getattr(v, "aval", None)
             if aval is not None:
                 avals.append(aval)
-        for p in eqn.params.values():
-            for sub in (p if isinstance(p, (tuple, list)) else (p,)):
-                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
-                    _walk_jaxpr(sub, eqns, prims, avals, consts)
+        for sub in eqn_sub_jaxprs(eqn):
+            _walk_jaxpr(sub, eqns, prims, avals, consts)
+
+
+@dataclasses.dataclass
+class EntryTrace:
+    """One entry's walked jaxpr — THE shared per-entry walk.
+
+    Both consumers of a traced entry run off this one object: the
+    probe's eqn/const/forbidden-primitive stats (``probe_op``) and the
+    kai-cost auditor's liveness/FLOP/traffic analysis
+    (``costmodel.py``).  Tracing the big fused entries costs seconds
+    each, so a full-gate CLI run builds each trace once and feeds it to
+    both layers.
+    """
+
+    name: str
+    #: the ClosedJaxpr from ``jax.make_jaxpr`` (costmodel's liveness
+    #: scan needs the nested eqn structure, not just the flat lists)
+    closed: object
+    #: flattened across every nesting level (``_walk_jaxpr``)
+    eqns: list
+    prims: list
+    avals: list
+    consts: list
+
+
+def trace_entry(spec: ProbeSpec, env) -> EntryTrace:
+    """Trace one registered op at the canonical env and walk its jaxpr
+    once — the shared front half of ``probe_op`` and every kai-cost
+    entry report."""
+    args, kwargs = spec.make_args(env)
+    trace_kwargs = {k: v for k, v in kwargs.items()
+                    if k in ("k_value",)}
+    closed = jax.make_jaxpr(spec.trace_fn)(*args, **trace_kwargs)
+    eqns, prims, avals, consts = [], [], [], []
+    _walk_jaxpr(closed, eqns, prims, avals, consts)
+    return EntryTrace(name=spec.name, closed=closed, eqns=eqns,
+                      prims=prims, avals=avals, consts=consts)
+
+
+def trace_entries(names: list[str] | None = None, *,
+                  env=None) -> list[EntryTrace]:
+    """Walked traces for the selected (default: all) registered ops."""
+    specs = _registry()
+    if names:
+        specs = [s for s in specs if s.name in set(names)]
+    if env is None:
+        env = _canonical_env(now=1000.0)
+    return [trace_entry(s, env) for s in specs]
 
 
 def _const_bytes(consts) -> int:
@@ -323,19 +394,18 @@ def _cache_size(fn) -> int | None:
         return None
 
 
-def probe_op(spec: ProbeSpec) -> OpReport:
+def probe_op(spec: ProbeSpec, trace: EntryTrace | None = None) -> OpReport:
     """Trace + execute one op: jaxpr walk, then the two-build
-    compile-cache assertion."""
+    compile-cache assertion.  Pass a pre-built ``trace`` (the shared
+    per-entry walk) to skip the re-trace — the cache assertion still
+    runs its own two fresh builds either way."""
     env_a = _canonical_env(now=1000.0)
     args, kwargs = spec.make_args(env_a)
-    trace_kwargs = {k: v for k, v in kwargs.items()
-                    if k in ("k_value",)}
-    closed = jax.make_jaxpr(spec.trace_fn)(*args, **trace_kwargs)
-    eqns, prims, avals, consts = [], [], [], []
-    _walk_jaxpr(closed, eqns, prims, avals, consts)
-    forbidden = sorted({p for p in prims
+    if trace is None:
+        trace = trace_entry(spec, env_a)
+    forbidden = sorted({p for p in trace.prims
                         for f in FORBIDDEN_PRIMITIVES if f in p})
-    f64 = sorted({str(a) for a in avals
+    f64 = sorted({str(a) for a in trace.avals
                   if getattr(a, "dtype", None) is not None
                   and str(a.dtype) in ("float64", "complex128")})
 
@@ -352,17 +422,19 @@ def probe_op(spec: ProbeSpec) -> OpReport:
     cache_hit = None
     if mid is not None and after is not None:
         cache_hit = after == mid and (before is None or mid - before <= 1)
-    return OpReport(name=spec.name, eqns=len(eqns),
-                    const_bytes=_const_bytes(consts),
+    return OpReport(name=spec.name, eqns=len(trace.eqns),
+                    const_bytes=_const_bytes(trace.consts),
                     forbidden=forbidden, f64_avals=f64,
                     cache_hit=cache_hit)
 
 
-def run_probe(names: list[str] | None = None) -> list[OpReport]:
+def run_probe(names: list[str] | None = None, *,
+              traces: list[EntryTrace] | None = None) -> list[OpReport]:
     specs = _registry()
     if names:
         specs = [s for s in specs if s.name in set(names)]
-    return [probe_op(s) for s in specs]
+    by_name = {t.name: t for t in traces} if traces else {}
+    return [probe_op(s, by_name.get(s.name)) for s in specs]
 
 
 # ---------------------------------------------------------------------------
@@ -410,19 +482,20 @@ def check_against_baseline(reports: list[OpReport], baseline: dict,
                 f"`python -m kai_scheduler_tpu.analysis --probe "
                 f"--update-baseline`")
             continue
-        max_eqns = int(base["eqns"] * (1 + EQN_TOLERANCE)) + 8
-        if r.eqns > max_eqns:
-            problems.append(
-                f"{r.name}: jaxpr grew to {r.eqns} eqns "
-                f"(baseline {base['eqns']}, allowed {max_eqns})")
-        max_const = int(base["const_bytes"] * (1 + CONST_TOLERANCE)
-                        ) + CONST_SLACK_BYTES
-        if r.const_bytes > max_const:
-            problems.append(
-                f"{r.name}: closed-over constants grew to "
-                f"{r.const_bytes}B (baseline {base['const_bytes']}B, "
-                f"allowed {max_const}B) — a baked-in table re-uploads "
-                f"per shape bucket")
+        # the shared tolerance helper (analysis/budgets.py) — one
+        # formula for every baseline-diffed layer (probe AND kai-cost)
+        p = budgets.budget_problem(
+            r.name, "jaxpr eqn count", r.eqns, base["eqns"],
+            tolerance=EQN_TOLERANCE, slack=8, unit=" eqns")
+        if p:
+            problems.append(p)
+        p = budgets.budget_problem(
+            r.name, "closed-over constants", r.const_bytes,
+            base["const_bytes"], tolerance=CONST_TOLERANCE,
+            slack=CONST_SLACK_BYTES, unit="B",
+            hint="a baked-in table re-uploads per shape bucket")
+        if p:
+            problems.append(p)
     if full_coverage:
         for name in sorted(set(baseline) - {r.name for r in reports}):
             problems.append(

@@ -163,6 +163,14 @@ def _resident_donate_argnums() -> tuple[int, ...]:
 #: carve-out exists to prevent)
 _RESIDENT_JIT_CACHE: dict = {}
 
+#: static argnames of the resident fused entry — ONE source of truth
+#: shared by the production jit build below and the kai-cost donation
+#: audit (``analysis/costmodel.py``), which re-jits the same signature
+#: with donation forced on
+RESIDENT_STATIC_ARGNAMES = ("actions", "num_levels", "acfg", "vcfg",
+                            "grace_s", "track_devices",
+                            "analytics_cfg")
+
 
 def _resident_jit():
     donate = _resident_donate_argnums()
@@ -173,9 +181,8 @@ def _resident_jit():
         # cache) cannot occur; the in-function build is deliberate so
         # the backend choice is read at first use, not at import
         fn = functools.partial(  # kai-lint: disable=KAI032
-            jax.jit, donate_argnums=donate, static_argnames=(
-                "actions", "num_levels", "acfg", "vcfg", "grace_s",
-                "track_devices", "analytics_cfg"))(resident_cycle)
+            jax.jit, donate_argnums=donate,
+            static_argnames=RESIDENT_STATIC_ARGNAMES)(resident_cycle)
         _RESIDENT_JIT_CACHE[donate] = fn
         # forward the jit cache probe through the public watched
         # wrapper so the trace probe's compile-once assertion keeps

@@ -20,13 +20,26 @@ with the committed ``docs/metrics/METRICS.md``.  Regenerate with::
 (``tests/test_metrics_catalog.py`` runs the same check against the
 LIVE registry, plus a meta-check that this AST extraction matches it.)
 
+It also drift-checks the **kai-cost baseline coverage** without
+importing jax: probe coverage and cost coverage ride ONE registry
+(``analysis/trace_probe._registry``), so ``baseline.json``'s ``probe``
+keys and ``cost_baseline.json``'s ``entries`` keys must be identical
+sets — a new jit entry baselined for the probe but missing a cost
+budget (or vice versa) fails here pre-commit, before the jax-heavy
+gate ever runs.  Refresh both in one invocation with::
+
+    python -m kai_scheduler_tpu.analysis --update-baseline
+
 Hook it up with::
 
     printf 'python scripts/lint.py || exit 1\n' >> .git/hooks/pre-commit
 
-The full gate (AST lint + jaxpr probe) is
-``python -m kai_scheduler_tpu.analysis``; the tier-1 suite runs it via
-``tests/test_analysis.py``.
+Exit status: 0 clean; 1 on any lint/race finding, metrics-doc drift,
+or cost-baseline coverage drift.  The full gate (AST lint + jaxpr
+probe + the kai-cost dataflow audit) is
+``python -m kai_scheduler_tpu.analysis`` (``--cost`` for the cost
+stage alone); the tier-1 suite runs it via ``tests/test_analysis.py``
+and ``tests/test_costmodel.py``.
 """
 import ast
 import os
@@ -41,6 +54,41 @@ from kai_scheduler_tpu.utils.metrics import parse_catalog  # noqa: E402
 METRICS_SRC = os.path.join(REPO_ROOT, "kai_scheduler_tpu", "framework",
                            "metrics.py")
 METRICS_DOC = os.path.join(REPO_ROOT, "docs", "metrics", "METRICS.md")
+PROBE_BASELINE = os.path.join(REPO_ROOT, "kai_scheduler_tpu",
+                              "analysis", "baseline.json")
+COST_BASELINE = os.path.join(REPO_ROOT, "kai_scheduler_tpu",
+                             "analysis", "cost_baseline.json")
+
+
+def check_cost_baseline(probe_path: str = PROBE_BASELINE,
+                        cost_path: str = COST_BASELINE) -> list[str]:
+    """kai-cost coverage drift, jax-free: the probe and cost baselines
+    budget the SAME registry of entries, so their key sets must match
+    exactly.  One message per divergence, empty when in sync."""
+    import json
+    if not os.path.exists(cost_path):
+        return [f"{cost_path} is missing — generate with `python -m "
+                f"kai_scheduler_tpu.analysis --cost --update-baseline`"]
+    if not os.path.exists(probe_path):
+        return [f"{probe_path} is missing — generate with `python -m "
+                f"kai_scheduler_tpu.analysis --probe --update-baseline`"]
+    with open(probe_path, encoding="utf-8") as f:
+        probe = set(json.load(f).get("probe", {}))
+    with open(cost_path, encoding="utf-8") as f:
+        cost = set(json.load(f).get("entries", {}))
+    problems = []
+    for name in sorted(probe - cost):
+        problems.append(
+            f"entry `{name}` has a probe baseline but no kai-cost "
+            f"budget in cost_baseline.json")
+    for name in sorted(cost - probe):
+        problems.append(
+            f"cost_baseline.json budgets `{name}` but the probe "
+            f"baseline has no such entry (stale?)")
+    if problems:
+        problems.append("refresh both in one invocation: python -m "
+                        "kai_scheduler_tpu.analysis --update-baseline")
+    return problems
 
 
 def registered_metrics_ast(path: str = METRICS_SRC) -> list[dict]:
@@ -115,4 +163,7 @@ if __name__ == "__main__":
     drift = check_metrics_doc()
     for msg in drift:
         print(f"METRICS-DOC DRIFT: {msg}", file=sys.stderr)
-    sys.exit(rc or (1 if drift else 0))
+    cost_drift = check_cost_baseline()
+    for msg in cost_drift:
+        print(f"COST-BASELINE DRIFT: {msg}", file=sys.stderr)
+    sys.exit(rc or (1 if drift or cost_drift else 0))

@@ -195,6 +195,18 @@ def test_known_jit_entry_points_probed():
         assert not missing, f"probe registry lost ops {missing} for {q}"
 
 
+def test_cost_coverage_rides_the_probe_registry():
+    """kai-cost (PR 14) audits the SAME registry the probe traces —
+    one shared per-entry walk, one coverage surface.  A jit entry that
+    passes the probe-coverage test above therefore cannot dodge the
+    cost auditor (its own meta-tests live in test_costmodel.py; this
+    pin keeps the two registries from ever forking)."""
+    from kai_scheduler_tpu.analysis.costmodel import (
+        registered_cost_entries)
+    from kai_scheduler_tpu.analysis.trace_probe import registered_ops
+    assert registered_cost_entries() == registered_ops()
+
+
 # ---------------------------------------------------------------------------
 # 3b. kai-race — thread-root discovery, guarded-by map coverage, and
 #     the package's race cleanliness (all pure AST, jax-free)
