@@ -1264,6 +1264,41 @@ def bench_e2e_alloc(iters: int) -> dict:
             "vs_baseline": round(50.0 / max(p99, 1e-9), 3)}
 
 
+def bench_twin(iters: int) -> dict:
+    """kai-twin replay throughput: a mid-size fuzz-generated stream
+    driven through the twin replayer, raw (digest=False) vs through
+    the full differential oracle — reports events/s and the oracle's
+    digesting overhead."""
+    from kai_scheduler_tpu.twin import fuzz, replay as twin_replay
+    stream = fuzz.generate("diurnal", seed=0, scale=2.0)
+    twin_replay.replay(stream, digest=False)  # compile
+    raw_eps, oracle_eps = [], []
+    ok = True
+    for _ in range(max(1, iters // 3)):
+        r = twin_replay.replay(stream, digest=False)
+        raw_eps.append(r.events_per_s)
+        v = twin_replay.oracle(stream)
+        ok = ok and v["ok"]
+        oracle_eps.append(
+            (v["replay"]["events_per_s"] + v["verify"]["events_per_s"])
+            / 2)
+    raw = max(raw_eps)
+    withd = max(oracle_eps)
+    overhead_pct = 100.0 * (raw - withd) / max(raw, 1e-9)
+    return {"metric": ("kai-twin replay events/s (raw, digest off) on "
+                       f"a {len(stream.events)}-event diurnal stream; "
+                       f"oracle overhead {overhead_pct:.1f}%, "
+                       f"bit-exact={ok}"),
+            "value": round(raw, 1), "unit": "events/s",
+            "vs_baseline": round(raw / 1000.0, 3),
+            "extra": {"twin": {
+                "events": len(stream.events),
+                "raw_events_per_s": round(raw, 1),
+                "oracle_events_per_s": round(withd, 1),
+                "oracle_overhead_pct": round(overhead_pct, 1),
+                "oracle_ok": ok}}}
+
+
 CONFIGS = {
     "1": bench_fairshare, "fairshare": bench_fairshare,
     "2": bench_scoring, "scoring": bench_scoring,
@@ -1283,6 +1318,7 @@ CONFIGS = {
     "headline": bench_headline,
     "e2e": bench_e2e,
     "e2e_alloc": bench_e2e_alloc,
+    "twin": bench_twin,
 }
 
 

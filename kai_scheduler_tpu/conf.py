@@ -125,8 +125,13 @@ def load_config(doc: dict | str | None,
     if depths:
         def depth(action, current):
             # explicit 0 means "attempt nothing", distinct from absent
-            # (keep default) — never collapse it to unlimited
-            return int(depths[action]) if action in depths else current
+            # (keep default) — never collapse it to unlimited; null IS
+            # unlimited, so the effective doc round-trips (kai-twin
+            # replays a recorded stream through its own header config)
+            if action not in depths:
+                return current
+            v = depths[action]
+            return None if v is None else int(v)
 
         allocate = dataclasses.replace(
             session.allocate,
@@ -138,6 +143,18 @@ def load_config(doc: dict | str | None,
                 "preempt", session.victims.queue_depth_preempt))
         session = dataclasses.replace(session, allocate=allocate,
                                       victims=victims)
+    victims_doc = doc.get("victims") or {}
+    if victims_doc:
+        # kai-twin tuner surface: the victim solver's sparse-scatter
+        # unit (KU) and the per-cycle victim pool bound
+        sk = victims_doc.get("sparseUnitK",
+                             session.victims.sparse_unit_k)
+        session = dataclasses.replace(
+            session, victims=dataclasses.replace(
+                session.victims,
+                sparse_unit_k=None if sk is None else int(sk),
+                max_victim_pods=int(victims_doc.get(
+                    "maxVictimPods", session.victims.max_victim_pods))))
     if "staleGangGracePeriodSeconds" in doc:
         session = dataclasses.replace(
             session, stale_grace_s=float(doc["staleGangGracePeriodSeconds"]))
@@ -200,6 +217,19 @@ def load_config(doc: dict | str | None,
         out = dataclasses.replace(
             out, incremental_dirty_threshold=float(
                 doc["incrementalDirtyThreshold"]))
+    if "analyticsEvery" in doc:
+        out = dataclasses.replace(
+            out, analytics_every=int(doc["analyticsEvery"]))
+    if "starvationAlarmCycles" in doc:
+        out = dataclasses.replace(
+            out, starvation_alarm_cycles=int(doc["starvationAlarmCycles"]))
+    if "seed" in doc:
+        # the kai-twin determinism anchor: every cycle derives its
+        # cycle_seed from (seed, cycle_index), so replaying a recorded
+        # stream with the same header seed reproduces the run bit-exact
+        out = dataclasses.replace(out, seed=int(doc["seed"]))
+    if "twinRecord" in doc:
+        out = dataclasses.replace(out, twin_record=bool(doc["twinRecord"]))
     if "pyroscopeAddress" in doc:
         out = dataclasses.replace(
             out, pyroscope_address=str(doc["pyroscopeAddress"] or ""))
@@ -246,6 +276,14 @@ def effective_config_doc(cfg: SchedulerConfig) -> dict:
             "policy": cfg.intake_policy,
             "batch": cfg.intake_batch,
         },
+        "victims": {
+            "sparseUnitK": cfg.session.victims.sparse_unit_k,
+            "maxVictimPods": cfg.session.victims.max_victim_pods,
+        },
+        "analyticsEvery": cfg.analytics_every,
+        "starvationAlarmCycles": cfg.starvation_alarm_cycles,
+        "seed": cfg.seed,
+        "twinRecord": cfg.twin_record,
         "incremental": cfg.incremental,
         "resident": cfg.resident,
         "verifyIncremental": cfg.verify_incremental,

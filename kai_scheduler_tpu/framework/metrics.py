@@ -291,6 +291,43 @@ intake_coalesce_seconds = registry.histogram(
     "kai_intake_coalesce_seconds",
     "Cycle-boundary coalesce latency (take staged + seq sort + "
     "sequential apply + bulk journal merge)")
+# kai-twin digital twin (twin/): recorded-stream replay, differential
+# oracle, scenario fuzzer, and the closed-loop policy tuner
+twin_recorded_events = registry.counter(
+    "kai_twin_recorded_events_total",
+    "Mutation events mirrored into the twin stream recorder at the "
+    "shared intake apply choke point")
+twin_replayed_events = registry.counter(
+    "kai_twin_replayed_events_total",
+    "Mutation events applied by the twin replayer (fresh scheduler + "
+    "cluster driven through a recorded or generated stream)")
+twin_replay_cycles = registry.counter(
+    "kai_twin_replay_cycles_total",
+    "Scheduling cycles executed by the twin replayer")
+twin_oracle_checks = registry.counter(
+    "kai_twin_oracle_checks_total",
+    "Digest fields compared by the differential oracle (binds, "
+    "evictions, decisions, journal cursor/generation, analytics, "
+    "clock, determinism anchors)")
+twin_oracle_divergences = registry.counter(
+    "kai_twin_oracle_divergences_total",
+    "Digest divergences the differential oracle found — any nonzero "
+    "value is a determinism bug")
+twin_fuzz_violations = registry.counter(
+    "kai_twin_fuzz_violations_total",
+    "Invariant violations found by the scenario fuzzer",
+    label_names=("family",))
+twin_fuzz_minimized = registry.counter(
+    "kai_twin_fuzz_minimized_total",
+    "Events dropped by the greedy event-drop delta-debugging minimizer")
+twin_tuner_rollouts = registry.counter(
+    "kai_twin_tuner_rollouts_total",
+    "Candidate-config rollouts replayed by the closed-loop policy "
+    "tuner")
+twin_tuner_best_score = registry.gauge(
+    "kai_twin_tuner_best_score",
+    "Best composite objective the policy tuner has found (weighted "
+    "goodput minus fairness drift, starvation age, and cycle p99)")
 
 
 def catalog() -> list[dict]:

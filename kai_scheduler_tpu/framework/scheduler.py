@@ -246,6 +246,30 @@ class CycleResult:
     repack: dict = dataclasses.field(default_factory=dict)
     #: host-side dispatch cost of the repack solve (0.0 when not fired)
     repack_seconds: float = 0.0
+    #: kai-twin determinism anchors: the cycle's logical index and the
+    #: per-cycle seed derived from ``SchedulerConfig.seed`` — pure
+    #: functions of (config seed, cycle index), never of wall clock or
+    #: process RNG, so two replays of the same stream observe identical
+    #: pairs by construction (twin/replay.py digests them)
+    cycle_index: int = 0
+    cycle_seed: int = 0
+
+
+def cycle_seed_for(seed: int, cycle_index: int) -> int:
+    """Deterministic per-cycle seed: a splitmix64-style mix of the
+    configured stream seed and the logical cycle index.  Stateless and
+    wall-clock-free on purpose — this is the ONLY randomness anchor the
+    decision path may consume, and it makes replay determinism a
+    construction rather than an audit finding (kai-twin's oracle pins
+    it per digest)."""
+    mask = 0xFFFFFFFFFFFFFFFF
+    x = (seed * 0x9E3779B97F4A7C15 + cycle_index + 1) & mask
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & mask
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & mask
+    x ^= x >> 31
+    return x & 0x7FFFFFFF
 
 
 class Action(Protocol):
@@ -431,6 +455,18 @@ class SchedulerConfig:
     intake_policy: str = "shed"
     #: max events per worker drain round (the vectorized admission batch)
     intake_batch: int = 512
+    #: kai-twin (twin/): the explicit determinism seed threaded through
+    #: ``run_once`` — each cycle derives ``cycle_seed_for(seed, index)``
+    #: onto its ``CycleResult``/trace, the only sanctioned randomness
+    #: anchor on the decision path (wall clock feeds timings ONLY).
+    #: Replays pin this from the stream header so same seed → same
+    #: stream → bit-identical decisions twice.
+    seed: int = 0
+    #: attach a kai-twin stream recorder to the server's stored cluster
+    #: at startup (``twin/stream.StreamRecorder`` via the shared intake
+    #: applier's choke point); recording is ring-bounded and costs one
+    #: list append per applied event
+    twin_record: bool = True
 
 
 def apply_shard_args(session: SessionConfig,
@@ -579,7 +615,9 @@ class Scheduler:
             result = self._run_traced(cluster, trace, t0)
             trace.root.attrs.update(
                 binds=len(result.bind_requests),
-                evictions=len(result.evictions))
+                evictions=len(result.evictions),
+                cycle_index=result.cycle_index,
+                cycle_seed=result.cycle_seed)
         return result
 
     def _run_traced(self, cluster: Cluster, trace, t0: float) -> CycleResult:
@@ -675,6 +713,12 @@ class Scheduler:
         open_s = t_open - t0
         metrics.open_session_latency.observe(value=open_s)
         result = CycleResult()
+        # kai-twin determinism anchor: logical index + derived seed,
+        # fixed before any action runs (pure function of config seed
+        # and index — never of wall clock)
+        result.cycle_index = self._cycle_index
+        result.cycle_seed = cycle_seed_for(self.config.seed,
+                                           self._cycle_index)
         if not resident_mode:
             result.tensors = init_result(session.state)
         result.open_seconds = open_s

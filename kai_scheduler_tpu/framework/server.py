@@ -102,6 +102,11 @@ DEBUG_SURFACES = (
               "queued/staged depth, accepted/shed/rejected counters, "
               "recent admission rejections, coalesce totals, worker "
               "liveness")},
+    {"path": "/debug/twin", "params": ("stream",),
+     "desc": ("kai-twin digital twin: stream recorder status "
+              "(attached/events/dropped) and the last differential-"
+              "oracle replay verdict; ?stream=1 inlines the full "
+              "recorded stream document")},
     {"path": "/debug/pprof", "params": (),
      "desc": ("one profiled cycle (cProfile): hottest host functions "
               "+ kai-trace phase breakdown")},
@@ -258,6 +263,19 @@ class SchedulerServer:
         #: threads swap in a fresh dict under _state_lock, readers take
         #: the current binding without it
         self._cycle_stats: dict | None = None  # kai-race: guarded-by=atomic-swap
+        # kai-twin stream recorder: attached to the stored cluster so
+        # the shared intake applier (intake/apply.py choke point)
+        # mirrors every applied mutation; /cycle/stored appends cycle
+        # marks.  The recorder is internally locked; the last oracle
+        # verdict is an immutable atomic-swapped doc, so GET
+        # /debug/twin and the healthz twin slice never take
+        # _state_lock.
+        self.recorder = None
+        self._twin_doc: dict | None = None  # kai-race: guarded-by=atomic-swap
+        if getattr(self.scheduler.config, "twin_record", False):
+            from ..twin import stream as twin_stream
+            self.recorder = twin_stream.StreamRecorder()
+            self._twin_attach(cluster)
         # continuous profiling (the Pyroscope analogue) — created here,
         # STARTED in start() so a never-started server leaks no sampler
         self.profiler = None
@@ -308,7 +326,8 @@ class SchedulerServer:
                     # lock or a full intake lane
                     stats = outer._cycle_stats
                     self._send({"ok": True, "last_cycle": stats,
-                                "intake": outer.intake.health()})
+                                "intake": outer.intake.health(),
+                                "twin": outer._twin_health()})
                 elif self.path.startswith("/debug/trace"):
                     # kai-trace flight recorder: the retained cycle ring
                     # as Chrome-trace JSON.  Only the scheduler HANDLE
@@ -394,6 +413,24 @@ class SchedulerServer:
                     with outer._state_lock:
                         sched = outer.scheduler
                     self._send(sched.repack_status())
+                elif self.path.startswith("/debug/twin"):
+                    # kai-twin status: recorder stats + the last
+                    # differential-oracle verdict; ?stream=1 inlines
+                    # the recorded stream document.  NO _state_lock —
+                    # the recorder is internally locked and the
+                    # verdict doc is atomic-swapped, so this scrape
+                    # can never block behind a running cycle.
+                    params = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    rec = outer.recorder
+                    twin = outer._twin_doc or {}
+                    doc = {"recording": rec is not None
+                           and rec.attached,
+                           "recorder": rec.stats() if rec else None,
+                           "last_replay": twin.get("last_replay")}
+                    if rec is not None and params.get("stream"):
+                        doc["stream"] = rec.doc()
+                    self._send(doc)
                 elif self.path in ("/debug", "/debug/"):
                     # index of every debug surface — static doc plus
                     # which optional surfaces are live right now
@@ -468,6 +505,7 @@ class SchedulerServer:
                             fresh = codec.cluster_from_msg(doc)  # no lock
                             with outer._state_lock:
                                 outer.cluster = fresh
+                                outer._twin_attach(fresh)
                             self._send_pb(pb.CommitSet())
                         elif self.path == "/cluster/delta":
                             delta = pb.ClusterDelta()
@@ -481,6 +519,8 @@ class SchedulerServer:
                                 result = outer.scheduler.run_once(
                                     outer.cluster)
                                 outer._record_cycle(result)
+                                if outer.recorder is not None:
+                                    outer.recorder.record_cycle()
                             self._send_pb(codec.commit_to_msg(result))
                         else:
                             self.send_error(404)
@@ -499,6 +539,7 @@ class SchedulerServer:
                         fresh = load_cluster(doc)
                         with outer._state_lock:
                             outer.cluster = fresh
+                            outer._twin_attach(fresh)
                         self._send({"ok": True})
                     elif self.path == "/cluster/delta":
                         # ... then PATCH deltas instead of re-shipping
@@ -542,7 +583,51 @@ class SchedulerServer:
                             result = outer.scheduler.run_once(
                                 outer.cluster)
                             outer._record_cycle(result)
+                            if outer.recorder is not None:
+                                outer.recorder.record_cycle()
                         self._send(_commit_doc(result))
+                    elif self.path == "/twin/record":
+                        # kai-twin recorder control: start re-anchors
+                        # the stream at the CURRENT stored cluster,
+                        # stop freezes it (the stream stays readable
+                        # through /debug/twin?stream=1)
+                        doc = json.loads(body.decode()) if body else {}
+                        action = doc.get("action", "start")
+                        if outer.recorder is None:
+                            self.send_error(
+                                400, "twin recording disabled "
+                                     "(twinRecord: false)")
+                            return
+                        with outer._state_lock:
+                            if action in ("start", "reset"):
+                                outer._twin_attach(outer.cluster)
+                            elif action == "stop":
+                                outer.recorder.detach()
+                                outer.cluster.twin_recorder = None
+                            else:
+                                self.send_error(
+                                    400, f"unknown action {action!r}")
+                                return
+                        self._send({"ok": True, "action": action,
+                                    "recorder":
+                                        outer.recorder.stats()})
+                    elif self.path == "/twin/replay":
+                        # differential-oracle replay of the recorded
+                        # stream: snapshot the stream under the
+                        # recorder's own lock, replay it twice OUTSIDE
+                        # _state_lock (a long replay must never stall
+                        # the live scheduler), then atomic-swap the
+                        # verdict for /debug/twin and healthz.
+                        if (outer.recorder is None
+                                or not outer.recorder.attached):
+                            self.send_error(
+                                400, "no twin stream recorded")
+                            return
+                        stream = outer.recorder.stream()
+                        from ..twin import replay as twin_replay
+                        verdict = twin_replay.oracle(stream)
+                        outer._twin_doc = {"last_replay": verdict}
+                        self._send(verdict)
                     else:
                         self.send_error(404)
                 except Exception as exc:  # noqa: BLE001
@@ -554,6 +639,33 @@ class SchedulerServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+
+    def _twin_attach(self, cluster: Cluster) -> None:
+        """(Re-)anchor the recorder: snapshot the stored cluster as the
+        stream header and hook the shared applier.  Called at
+        construction and whenever ``POST /cluster`` replaces the
+        stored document (under ``_state_lock`` there)."""
+        if self.recorder is None:
+            return
+        from .. import conf as conf_mod
+        cfg = self.scheduler.config
+        self.recorder.attach(dump_cluster(cluster), seed=cfg.seed,
+                             config=conf_mod.effective_config_doc(cfg))
+        cluster.twin_recorder = self.recorder
+
+    def _twin_health(self) -> dict:
+        """The healthz twin slice — recorder + last-oracle state, no
+        ``_state_lock`` (recorder is internally locked, the verdict
+        doc is atomic-swapped)."""
+        if self.recorder is None:
+            return {"recording": False}
+        out = dict(self.recorder.stats())
+        twin = self._twin_doc
+        if twin and twin.get("last_replay"):
+            out["last_replay_ok"] = twin["last_replay"]["ok"]
+            out["last_replay_divergences"] = len(
+                twin["last_replay"]["divergences"])
+        return out
 
     def _record_cycle(self, result) -> None:
         """Swap in a fresh immutable per-cycle stats document (served

@@ -235,6 +235,13 @@ def apply_events(cluster, events, errors: list | None = None) -> int:
     trips collection thresholds constantly — measured ~3x slowdown on
     a 100k-create storm with the collector left running."""
     journal = cluster.journal
+    # kai-twin choke point: when a recorder is attached to the hub,
+    # every event this call successfully applies is mirrored into its
+    # stream (AFTER the journal merge below) — recording the APPLIED
+    # sequence, never the offered one, is what makes a recorded stream
+    # replayable bit-exact through this same function
+    recorder = getattr(cluster, "twin_recorder", None)
+    applied: list | None = [] if recorder is not None else None
     marks: list = []
     n = 0
     gc_was_on = gc.isenabled()
@@ -243,9 +250,9 @@ def apply_events(cluster, events, errors: list | None = None) -> int:
     try:
         for ev in events:
             if isinstance(ev, IntakeEvent):
-                op, coll, payload = ev.op, ev.coll, ev.payload
+                op, coll, key, payload = ev.op, ev.coll, ev.key, ev.payload
             else:
-                op, coll, _key, payload = ev
+                op, coll, key, payload = ev
             if errors is None:
                 apply_event(cluster, op, coll, payload, marks)
             else:
@@ -257,6 +264,8 @@ def apply_events(cluster, events, errors: list | None = None) -> int:
                     errors.append((getattr(ev, "seq", n), str(exc)))
                     n += 1
                     continue
+            if applied is not None:
+                applied.append((op, coll, key, payload))
             n += 1
             if len(marks) >= _MARK_CHUNK:
                 # swap-before-merge: if the merge raises mid-chunk the
@@ -280,6 +289,13 @@ def apply_events(cluster, events, errors: list | None = None) -> int:
         finally:
             if gc_was_on:
                 gc.enable()
+        # record the applied PREFIX even when a classic-path event
+        # raised mid-batch: what reached the journal is what the twin
+        # must replay
+        if recorder is not None and applied:
+            recorder.record_events(applied)
+            from ..framework import metrics
+            metrics.twin_recorded_events.inc(by=len(applied))
     return n
 
 

@@ -61,6 +61,12 @@ class Cluster:
     #: consolidation-move path) — on the next tick they return to PENDING
     #: instead of vanishing
     restarting: set[str] = dataclasses.field(default_factory=set)
+    #: kai-twin recorder hook (``twin/stream.StreamRecorder``): when
+    #: set, the shared intake applier mirrors every successfully
+    #: applied event into the recorder's stream.  Deepcopied clusters
+    #: drop the hook (the recorder's ``__deepcopy__`` returns None) so
+    #: a profiling/differential twin never re-records its own replay.
+    twin_recorder: "object" = None
 
     # -- intake -----------------------------------------------------------
 

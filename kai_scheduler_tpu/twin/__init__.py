@@ -1,0 +1,24 @@
+"""kai-twin: deterministic cluster digital twin (ROADMAP item 5).
+
+Three layers over the observability stack of PRs 6-12:
+
+- ``twin.stream``  — versioned on-disk stream format for journal event
+  sequences (explicit seed + logical clocks) and the live-server
+  recorder hooked at the shared intake-apply choke point.  Stdlib-only
+  module: ``scripts/lint.py`` imports it to validate checked-in
+  scenario streams without pulling jax.
+- ``twin.replay``  — drives a fresh ``Scheduler`` + ``Cluster`` through
+  a recorded stream via the SAME ``intake/apply.py`` path the live
+  server uses, digesting every cycle's commits/decisions/journal/
+  analytics; the differential oracle asserts two replays (or a replay
+  vs the recorded live run) are bit-exact.
+- ``twin.fuzz``    — seeded scenario generator families with invariant
+  sets and a greedy event-drop minimizer; minimized streams are
+  checked in under ``tests/scenarios/streams/``.
+- ``twin.tune``    — closed-loop policy autotuner over the live conf
+  knobs, scoring rollouts against the kai-pulse objectives; winners
+  emit a ``conf.py``-loadable overlay.
+
+Submodules import lazily on purpose — ``twin.stream`` must stay
+importable without the jax-heavy framework packages.
+"""

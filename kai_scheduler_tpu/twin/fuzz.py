@@ -1,0 +1,622 @@
+"""kai-twin adversarial scenario fuzzer.
+
+Seeded generator families emit valid twin streams plus an invariant
+set; :func:`evaluate` replays a stream through the twin (shared apply
+path) probing the invariants each cycle; any violating stream is
+shrunk by :func:`minimize` — greedy event-drop delta-debugging — and
+checked in under ``tests/scenarios/streams/`` as a permanent
+regression (``scripts/lint.py`` gates the files' validity, and
+``tests/test_twin.py`` re-evaluates their invariants every run).
+
+Families:
+
+- ``diurnal``        — traffic waves: arrival bursts rise and fall,
+  finished gangs drain out
+- ``rack_failure``   — a correlated rack outage under load, nodes
+  restored later; pending must drain and fragmentation recover
+- ``quota_storm``    — two tenants storm past their queue limits;
+  bound usage must never overshoot a limit and the starvation alarm
+  must fire within K cycles
+- ``burst_trains``   — arrival/cancel trains with same-key
+  create→delete→create races
+- ``priority_churn`` — high-priority gangs land on a full cluster and
+  priorities are rewritten mid-flight (preemption churn)
+
+Regenerate the checked-in scenarios with::
+
+    python -m kai_scheduler_tpu.twin.fuzz --write-scenarios \
+        tests/scenarios/streams
+"""
+from __future__ import annotations
+
+import os
+import random
+
+from ..apis import types as apis
+from . import stream as stream_mod
+from .stream import Stream
+
+#: decision outcomes (runtime/events.py) the signatures key on
+_STARVED = "starved"
+_QUOTA_GATE = "quota-gate"
+_PREEMPTED = "preempted-for"
+
+
+# ---------------------------------------------------------------------------
+# base snapshots + delta builders
+# ---------------------------------------------------------------------------
+
+
+def _base_snapshot(num_nodes: int = 4, node_accel: float = 8.0,
+                   queues_per_department: int = 2,
+                   topology_levels: tuple[int, ...] = (2,),
+                   num_gangs: int = 0, tasks_per_gang: int = 2,
+                   task_accel: float = 1.0,
+                   running_fraction: float = 0.0,
+                   accel_limit: float | None = None,
+                   seed: int = 0) -> dict:
+    """A ``dump_cluster`` doc from the synthetic builder — one
+    department, leaf queues ``queue-0-*``; optional per-leaf accel
+    limit (the quota-storm shape)."""
+    from ..runtime.cluster import Cluster
+    from ..runtime.snapshot import dump_cluster
+    from ..state import make_cluster
+    nodes, queues, groups, pods, topo = make_cluster(
+        num_nodes=num_nodes, node_accel=node_accel,
+        num_departments=1, queues_per_department=queues_per_department,
+        num_gangs=num_gangs, tasks_per_gang=tasks_per_gang,
+        task_accel=task_accel, running_fraction=running_fraction,
+        topology_levels=topology_levels, seed=seed)
+    if accel_limit is not None:
+        for q in queues:
+            if q.parent is not None:
+                q.accel = apis.QueueResource(quota=q.accel.quota,
+                                             limit=accel_limit)
+    cluster = Cluster.from_objects(nodes, queues, groups, pods, topo)
+    return dump_cluster(cluster)
+
+
+def _gang_delta(name: str, queue: str, tasks: int, accel: float,
+                priority: int = 0) -> dict:
+    return {
+        "pod_groups_upsert": [{"name": name, "queue": queue,
+                               "min_member": tasks,
+                               "priority": priority}],
+        "pods_upsert": [{"name": f"{name}-t{i}", "group": name,
+                         "resources": {"accel": accel}}
+                        for i in range(tasks)],
+    }
+
+
+def _gang_delete(name: str, tasks: int) -> dict:
+    return {"pods_delete": [f"{name}-t{i}" for i in range(tasks)],
+            "pod_groups_delete": [name]}
+
+
+def _step(st: Stream, ticks: float = 1.0) -> None:
+    """One simulated control-loop step: cycle, bind, advance time."""
+    st.append("cycle")
+    st.append("reconcile")
+    st.append("tick", seconds=ticks)
+
+
+def _node_doc(i: int, num_nodes: int, accel: float,
+              levels: tuple[int, ...] = (2,)) -> dict:
+    """Re-create the synthetic builder's node doc (for restore-after-
+    failure upserts) — labels must match ``make_cluster``'s nesting."""
+    labels = {"kubernetes.io/hostname": f"node-{i}"}
+    span, idx = num_nodes, i
+    for li, size in enumerate(levels):
+        span = max(1, span // size)
+        labels[f"topo/level{li}"] = f"level{li}-{idx // span}"
+        idx = idx % span
+    return {"name": f"node-{i}", "labels": labels,
+            "allocatable": {"accel": accel, "cpu": 64.0,
+                            "memory": 256.0}}
+
+
+# ---------------------------------------------------------------------------
+# generator families
+# ---------------------------------------------------------------------------
+
+
+def _gen_diurnal(rng: random.Random, scale: float) -> Stream:
+    st = Stream(snapshot=_base_snapshot(num_nodes=4),
+                config={"analyticsEvery": 1},
+                meta={"family": "diurnal"})
+    wave = [1, 2, 3, 2, 1, 0, 1, 2]
+    phases = max(4, int(len(wave) * scale))
+    alive: list[str] = []
+    gid = 0
+    for ph in range(phases):
+        arrivals = wave[ph % len(wave)]
+        for _ in range(arrivals):
+            name = f"wave-{gid}"
+            gid += 1
+            st.append("delta", delta=_gang_delta(
+                name, f"queue-0-{rng.randrange(2)}", 2, 2.0))
+            alive.append(name)
+        _step(st)
+        # the oldest gangs finish and drain out (diurnal fall)
+        while len(alive) > 6:
+            done = alive.pop(0)
+            st.append("delta", delta=_gang_delete(done, 2))
+    _step(st)
+    st.invariants = [{"name": "no_lost_gang"},
+                     {"name": "clock_monotonic"},
+                     {"name": "journal_generation_monotonic"}]
+    return st
+
+
+def _gen_rack_failure(rng: random.Random, scale: float) -> Stream:
+    num_nodes, accel = 4, 8.0
+    st = Stream(snapshot=_base_snapshot(num_nodes=num_nodes,
+                                        node_accel=accel),
+                config={"analyticsEvery": 1},
+                meta={"family": "rack_failure"})
+    # demand fits the FULL cluster but not the degraded one
+    for g in range(6):
+        st.append("delta", delta=_gang_delta(
+            f"job-{g}", f"queue-0-{g % 2}", 2, 2.0))
+    # rack 0 (nodes 0..1) fails before anything binds
+    st.append("delta", delta={"nodes_delete": ["node-0", "node-1"]})
+    degraded = max(2, int(3 * scale))
+    for _ in range(degraded):
+        _step(st)
+    # rack restored; everything must drain
+    st.append("delta", delta={"nodes_upsert": [
+        _node_doc(i, num_nodes, accel) for i in (0, 1)]})
+    for _ in range(max(3, int(4 * scale))):
+        _step(st)
+    st.invariants = [{"name": "no_lost_gang"},
+                     {"name": "clock_monotonic"},
+                     {"name": "pending_drains"},
+                     {"name": "frag_recovers"}]
+    return st
+
+
+def _gen_quota_storm(rng: random.Random, scale: float) -> Stream:
+    st = Stream(snapshot=_base_snapshot(num_nodes=4, accel_limit=12.0),
+                config={"analyticsEvery": 1,
+                        "starvationAlarmCycles": 4},
+                meta={"family": "quota_storm"})
+    # both tenants storm to 2x their limit — the surplus MUST pend
+    for g in range(6):
+        for q in (0, 1):
+            st.append("delta", delta=_gang_delta(
+                f"storm-q{q}-{g}", f"queue-0-{q}", 2, 2.0))
+    for _ in range(max(8, int(8 * scale))):
+        _step(st)
+    st.invariants = [{"name": "no_lost_gang"},
+                     {"name": "clock_monotonic"},
+                     {"name": "no_quota_overshoot"},
+                     {"name": "starvation_alarm_fires",
+                      "k": 4, "slack": 4}]
+    return st
+
+
+def _gen_burst_trains(rng: random.Random, scale: float) -> Stream:
+    st = Stream(snapshot=_base_snapshot(num_nodes=4),
+                config={"analyticsEvery": 1},
+                meta={"family": "burst_trains"})
+    trains = max(2, int(3 * scale))
+    for t in range(trains):
+        burst = [f"burst-{t}-{i}" for i in range(4)]
+        for name in burst:
+            st.append("delta", delta=_gang_delta(
+                name, f"queue-0-{rng.randrange(2)}", 2, 2.0))
+        _step(st)
+        # cancel half the train mid-flight ...
+        for name in burst[:2]:
+            st.append("delta", delta=_gang_delete(name, 2))
+        # ... and re-arrive under the SAME key with a new shape (the
+        # same-key create→delete→create race)
+        st.append("delta", delta=_gang_delta(
+            burst[0], "queue-0-0", 1, 4.0))
+        _step(st)
+        st.append("delta", delta=_gang_delete(burst[0], 1))
+        st.append("delta", delta=_gang_delete(burst[2], 2))
+        st.append("delta", delta=_gang_delete(burst[3], 2))
+    _step(st)
+    st.invariants = [{"name": "no_lost_gang"},
+                     {"name": "clock_monotonic"},
+                     {"name": "journal_generation_monotonic"}]
+    return st
+
+
+def _gen_priority_churn(rng: random.Random, scale: float) -> Stream:
+    # the cluster starts FULL of low-priority running gangs (4 gangs x
+    # 4 tasks x 2 accel = all 32 devices) — a VIP arrival MUST preempt
+    st = Stream(snapshot=_base_snapshot(num_nodes=4, num_gangs=4,
+                                        tasks_per_gang=4,
+                                        task_accel=2.0,
+                                        running_fraction=1.0),
+                config={"analyticsEvery": 1},
+                meta={"family": "priority_churn"})
+    rounds = max(2, int(3 * scale))
+    for r in range(rounds):
+        # high-priority arrivals outrank the residents of their queue
+        st.append("delta", delta=_gang_delta(
+            f"vip-{r}", f"queue-0-{r % 2}", 2, 2.0, priority=10))
+        _step(st)
+        # churn: rewrite a resident's priority mid-flight
+        st.append("delta", delta={"pod_groups_upsert": [
+            {"name": f"gang-{r % 4}", "priority": rng.randrange(12)}]})
+        _step(st)
+    _step(st)
+    st.invariants = [{"name": "no_lost_gang"},
+                     {"name": "clock_monotonic"},
+                     {"name": "journal_generation_monotonic"}]
+    return st
+
+
+FAMILIES = {
+    "diurnal": _gen_diurnal,
+    "rack_failure": _gen_rack_failure,
+    "quota_storm": _gen_quota_storm,
+    "burst_trains": _gen_burst_trains,
+    "priority_churn": _gen_priority_churn,
+}
+
+
+def generate(family: str, seed: int = 0, scale: float = 1.0) -> Stream:
+    """One seeded stream from a family — same (family, seed, scale) →
+    identical stream document, by construction (the determinism
+    property test pins this)."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown family {family!r}; "
+                         f"have {sorted(FAMILIES)}")
+    st = FAMILIES[family](random.Random(seed), scale)
+    st.seed = seed
+    st.meta.setdefault("family", family)
+    st.meta["generator_seed"] = seed
+    st.meta["scale"] = scale
+    return st
+
+
+# ---------------------------------------------------------------------------
+# invariant evaluation (per-cycle probes over a twin replay)
+# ---------------------------------------------------------------------------
+
+
+def _queue_bound_accel(cluster) -> dict[str, float]:
+    """Accel actively held per queue: BOUND/RUNNING pods plus pending
+    pods with an in-flight Pending BindRequest (the snapshot presents
+    those as bound — the quota machinery already charges them)."""
+    usage: dict[str, float] = {}
+    for p in cluster.pods.values():
+        active = p.status in (apis.PodStatus.BOUND,
+                              apis.PodStatus.RUNNING)
+        if not active and p.status == apis.PodStatus.PENDING:
+            br = cluster.bind_requests.get(p.name)
+            active = br is not None and br.phase == "Pending"
+        if not active:
+            continue
+        g = cluster.pod_groups.get(p.group)
+        if g is None:
+            continue
+        usage[g.queue] = usage.get(g.queue, 0.0) + p.resources.accel
+    return usage
+
+
+def _pending_gangs(cluster) -> set[str]:
+    pending: set[str] = set()
+    for g in cluster.pod_groups.values():
+        for p in cluster.pods.values():
+            if p.group != g.name:
+                continue
+            if p.status == apis.PodStatus.PENDING and \
+                    cluster.bind_requests.get(p.name) is None:
+                pending.add(g.name)
+                break
+    return pending
+
+
+def _expected_gangs(stream: Stream) -> set[str]:
+    """Replay the stream's pod_group upserts/deletes symbolically."""
+    expected = set()
+    if stream.snapshot:
+        expected |= {g["name"] for g in
+                     stream.snapshot.get("pod_groups", [])}
+    for ev in stream.events:
+        if ev["op"] == "delta":
+            d = ev["delta"]
+            expected |= {g["name"]
+                         for g in d.get("pod_groups_upsert", [])}
+            expected -= set(d.get("pod_groups_delete", []))
+        elif ev["op"] == "events":
+            for op, coll, key, payload in ev["events"]:
+                if coll != "pod_groups":
+                    continue
+                if op == "upsert":
+                    expected.add(payload.get("name") or key)
+                elif op == "delete":
+                    expected.discard(payload)
+    return expected
+
+
+def _inv_no_lost_gang(ctx, **_) -> list[str]:
+    final = set(ctx["cluster"].pod_groups)
+    missing = _expected_gangs(ctx["stream"]) - final
+    return [f"no_lost_gang: gang {g!r} vanished without a delete"
+            for g in sorted(missing)]
+
+
+def _inv_clock_monotonic(ctx, **_) -> list[str]:
+    nows = ctx["obs"]["now"]
+    return [f"clock_monotonic: now went backwards at cycle {i} "
+            f"({a} -> {b})"
+            for i, (a, b) in enumerate(zip(nows, nows[1:])) if b < a]
+
+
+def _inv_journal_monotonic(ctx, **_) -> list[str]:
+    gens = ctx["obs"]["generation"]
+    return [f"journal_generation_monotonic: generation regressed at "
+            f"cycle {i} ({a} -> {b})"
+            for i, (a, b) in enumerate(zip(gens, gens[1:])) if b < a]
+
+
+def _inv_no_quota_overshoot(ctx, tol: float = 1e-6, **_) -> list[str]:
+    out = []
+    for cyc, queue, used, limit in ctx["obs"]["overshoot"]:
+        if used > limit + tol:
+            out.append(f"no_quota_overshoot: queue {queue!r} holds "
+                       f"{used} accel > limit {limit} at cycle {cyc}")
+    return out
+
+
+def _inv_starvation_alarm(ctx, k: int = 4, slack: int = 4,
+                          **_) -> list[str]:
+    streak: dict[str, int] = {}
+    worst = 0
+    for pending in ctx["obs"]["pending"]:
+        for g in pending:
+            streak[g] = streak.get(g, 0) + 1
+            worst = max(worst, streak[g])
+        for g in list(streak):
+            if g not in pending:
+                streak[g] = 0
+    if worst < k + slack:
+        return []  # nothing starved long enough to demand an alarm
+    if ctx["obs"]["starved"]:
+        return []
+    return [f"starvation_alarm_fires: a gang stayed pending {worst} "
+            f"cycles but no `starved` decision fired (k={k})"]
+
+
+def _inv_pending_drains(ctx, **_) -> list[str]:
+    pending = ctx["obs"]["pending"]
+    last = pending[-1] if pending else set()
+    return [f"pending_drains: {sorted(last)} still pending at stream "
+            f"end"] if last else []
+
+
+def _inv_frag_recovers(ctx, tol: float = 1e-6, **_) -> list[str]:
+    frags = ctx["obs"]["frag"]
+    if len(frags) < 2:
+        return []
+    peak, final = max(frags[:-1]), frags[-1]
+    return [f"frag_recovers: final fragmentation {final} exceeds "
+            f"the in-stream peak {peak}"] if final > peak + tol else []
+
+
+INVARIANTS = {
+    "no_lost_gang": _inv_no_lost_gang,
+    "clock_monotonic": _inv_clock_monotonic,
+    "journal_generation_monotonic": _inv_journal_monotonic,
+    "no_quota_overshoot": _inv_no_quota_overshoot,
+    "starvation_alarm_fires": _inv_starvation_alarm,
+    "pending_drains": _inv_pending_drains,
+    "frag_recovers": _inv_frag_recovers,
+}
+
+
+def evaluate(stream: Stream, base=None) -> dict:
+    """Replay a stream through the twin, probing its invariant set
+    each cycle.  Returns ``{"violations": [...], "report": ...,
+    "obs": ...}`` — empty violations means the scenario holds."""
+    from ..framework import metrics
+    from . import replay as replay_mod
+    obs = {"now": [], "generation": [], "pending": [], "frag": [],
+           "overshoot": [], "starved": set(), "binds_by_cycle": [],
+           "cycle": 0}
+
+    def on_cycle(cluster, result, digest):
+        cyc = obs["cycle"]
+        obs["cycle"] += 1
+        obs["now"].append(cluster.now)
+        obs["generation"].append(cluster.journal.generation)
+        obs["pending"].append(_pending_gangs(cluster))
+        obs["binds_by_cycle"].append(len(result.bind_requests))
+        usage = _queue_bound_accel(cluster)
+        for qname, used in usage.items():
+            q = cluster.queues.get(qname)
+            limit = q.accel.limit if q is not None else apis.UNLIMITED
+            if limit >= 0:
+                obs["overshoot"].append((cyc, qname, used, limit))
+        if digest:
+            for gang, _q, outcome, _d in digest["decisions"]:
+                if outcome == _STARVED:
+                    obs["starved"].add(gang)
+        a = result.analytics
+        if a:
+            obs["frag"].append(a["fragmentation"]["score"])
+
+    report = replay_mod.replay(stream, base=base, on_cycle=on_cycle)
+    ctx = {"stream": stream, "report": report, "obs": obs,
+           "cluster": report.cluster}
+    violations: list[str] = []
+    for inv in stream.invariants:
+        fn = INVARIANTS.get(inv["name"])
+        if fn is None:
+            violations.append(f"unknown invariant {inv['name']!r}")
+            continue
+        params = {k: v for k, v in inv.items() if k != "name"}
+        violations.extend(fn(ctx, **params))
+    if violations:
+        family = stream.meta.get("family", "unknown")
+        metrics.twin_fuzz_violations.inc(family, by=len(violations))
+    return {"violations": violations, "report": report, "obs": obs}
+
+
+def fuzz(families=None, seeds=range(2), scale: float = 1.0,
+         base=None) -> list[dict]:
+    """Sweep family × seed; returns one record per violating stream."""
+    found = []
+    for family in (families or sorted(FAMILIES)):
+        for seed in seeds:
+            st = generate(family, seed=seed, scale=scale)
+            res = evaluate(st, base=base)
+            if res["violations"]:
+                found.append({"family": family, "seed": seed,
+                              "stream": st,
+                              "violations": res["violations"]})
+    return found
+
+
+# ---------------------------------------------------------------------------
+# greedy event-drop delta-debugging
+# ---------------------------------------------------------------------------
+
+
+def minimize(stream: Stream, predicate, budget: int = 200) -> Stream:
+    """Shrink a stream to a minimal event list still satisfying
+    ``predicate(candidate) -> bool`` (ddmin-style: halves, then
+    smaller chunks, down to single events).  ``budget`` bounds the
+    number of candidate replays."""
+    from ..framework import metrics
+    events = list(stream.events)
+    original = len(events)
+    tries = 0
+
+    def ok(evts: list[dict]) -> bool:
+        nonlocal tries
+        if tries >= budget:
+            return False
+        tries += 1
+        try:
+            return bool(predicate(stream.copy_with_events(evts)))
+        except Exception:  # noqa: BLE001 — a broken candidate is
+            # simply "not interesting", never a minimizer crash
+            return False
+
+    size = max(1, len(events) // 2)
+    while size >= 1 and tries < budget:
+        i = 0
+        while i < len(events) and tries < budget:
+            cand = events[:i] + events[i + size:]
+            if cand and ok(cand):
+                events = cand
+            else:
+                i += size
+        if size == 1:
+            break
+        size = max(1, size // 2)
+    dropped = original - len(events)
+    if dropped > 0:
+        metrics.twin_fuzz_minimized.inc(by=dropped)
+    out = stream.copy_with_events(events)
+    out.meta = dict(stream.meta, minimized_from=original,
+                    minimized_to=len(events))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scenario check-in (family signatures + regeneration entry point)
+# ---------------------------------------------------------------------------
+
+
+def _sig_diurnal(stream: Stream, res: dict) -> bool:
+    busy = [b for b in res["obs"]["binds_by_cycle"] if b > 0]
+    return len(busy) >= 2
+
+
+def _sig_rack_failure(stream: Stream, res: dict) -> bool:
+    deleted = any(ev["op"] == "delta"
+                  and ev["delta"].get("nodes_delete")
+                  for ev in stream.events)
+    restored = any(ev["op"] == "delta"
+                   and ev["delta"].get("nodes_upsert")
+                   for ev in stream.events)
+    return (deleted and restored
+            and sum(res["obs"]["binds_by_cycle"]) > 0)
+
+
+def _sig_quota_storm(stream: Stream, res: dict) -> bool:
+    gated = any(outcome in (_QUOTA_GATE, _STARVED)
+                for d in res["report"].digests
+                for _g, _q, outcome, _det in d["decisions"])
+    return gated or bool(res["obs"]["starved"])
+
+
+def _sig_burst_trains(stream: Stream, res: dict) -> bool:
+    seen: dict[str, str] = {}
+    race = False
+    for ev in stream.events:
+        if ev["op"] != "delta":
+            continue
+        for g in ev["delta"].get("pod_groups_upsert", []):
+            if seen.get(g["name"]) == "deleted":
+                race = True
+            seen[g["name"]] = "live"
+        for name in ev["delta"].get("pod_groups_delete", []):
+            seen[name] = "deleted"
+    return race and sum(res["obs"]["binds_by_cycle"]) > 0
+
+
+def _sig_priority_churn(stream: Stream, res: dict) -> bool:
+    return any(d["evictions"] or any(o == _PREEMPTED for _g, _q, o, _d
+                                     in d["decisions"])
+               for d in res["report"].digests)
+
+
+SIGNATURES = {
+    "diurnal": _sig_diurnal,
+    "rack_failure": _sig_rack_failure,
+    "quota_storm": _sig_quota_storm,
+    "burst_trains": _sig_burst_trains,
+    "priority_churn": _sig_priority_churn,
+}
+
+
+def make_scenario(family: str, seed: int = 0, scale: float = 1.0,
+                  budget: int = 120) -> Stream:
+    """The check-in pipeline for one family: generate, verify the
+    invariants hold AND the family's signature behavior shows, then
+    minimize while preserving both — the smallest stream that still
+    exercises the scenario, pinned as a permanent regression."""
+    st = generate(family, seed=seed, scale=scale)
+    sig = SIGNATURES[family]
+
+    def interesting(cand: Stream) -> bool:
+        res = evaluate(cand)
+        return not res["violations"] and sig(cand, res)
+
+    if not interesting(st):
+        raise RuntimeError(
+            f"family {family!r} seed {seed} does not exercise its own "
+            f"signature — regenerate with another seed/scale")
+    return minimize(st, interesting, budget=budget)
+
+
+def write_scenarios(outdir: str, seed: int = 0,
+                    budget: int = 120) -> list[str]:
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for family in sorted(FAMILIES):
+        st = make_scenario(family, seed=seed, budget=budget)
+        path = os.path.join(outdir, f"{family}.stream.json")
+        stream_mod.write_stream(st, path)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":  # pragma: no cover - regeneration tool
+    import sys
+    if len(sys.argv) == 3 and sys.argv[1] == "--write-scenarios":
+        for p in write_scenarios(sys.argv[2]):
+            print(f"wrote {p}")
+    else:
+        print("usage: python -m kai_scheduler_tpu.twin.fuzz "
+              "--write-scenarios DIR", file=sys.stderr)
+        raise SystemExit(2)

@@ -1,0 +1,298 @@
+"""kai-twin stream format + live recorder.
+
+A *stream* is everything a deterministic replay needs: the starting
+cluster snapshot (``runtime/snapshot.dump_cluster`` form), an explicit
+seed, an optional ``conf.py`` config overlay, and an ordered event list
+where every event carries a monotonically increasing logical clock
+(``lc``).  Five event kinds:
+
+- ``events``    — a batch of already-decomposed intake events
+  ``[op, coll, key, payload]`` (the recorder's output: exactly what the
+  shared applier applied, in order)
+- ``delta``     — a delta document (``POST /cluster/delta`` shape), the
+  synthetic-generator form; replay decomposes it through the same
+  ``intake/apply.decompose_delta``
+- ``cycle``     — run one scheduling cycle
+- ``tick``      — advance the cluster clock (``seconds``)
+- ``reconcile`` — run the binder over pending BindRequests
+
+The recorder (:class:`StreamRecorder`) hooks the ONE choke point both
+live mutation paths share — ``intake/apply.apply_events`` — via the
+``Cluster.twin_recorder`` attribute, so a recorded stream is the
+applied event sequence by construction, not a reconstruction.
+
+This module is deliberately stdlib-only at import time:
+``scripts/lint.py`` uses :func:`validate_stream_doc` to gate the
+checked-in scenario streams without importing jax.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import gzip
+import json
+import threading
+
+FORMAT = "kai-twin-stream"
+VERSION = 1
+
+EVENT_OPS = ("events", "delta", "cycle", "tick", "reconcile")
+
+#: recorder ring bound — keep-first/drop-new: the header snapshot is
+#: the state at recording start, so the retained PREFIX stays
+#: replayable; dropping old events would orphan the snapshot
+DEFAULT_EVENT_LIMIT = 200_000
+
+
+@dataclasses.dataclass
+class Stream:
+    """One recorded (or generated) twin stream."""
+
+    seed: int = 0
+    #: ``dump_cluster`` document of the starting state; None = empty
+    snapshot: dict | None = None
+    #: ``conf.load_config`` overlay applied to the replaying scheduler
+    config: dict | None = None
+    #: ordered events, each ``{"op": ..., "lc": n, ...}``
+    events: list[dict] = dataclasses.field(default_factory=list)
+    #: fuzzer invariant set: ``[{"name": ..., **params}, ...]``
+    invariants: list[dict] = dataclasses.field(default_factory=list)
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def append(self, op: str, **fields) -> dict:
+        """Append one event, assigning the next logical clock."""
+        if op not in EVENT_OPS:
+            raise ValueError(f"unknown stream op {op!r}")
+        lc = (self.events[-1]["lc"] + 1) if self.events else 0
+        ev = {"op": op, "lc": lc, **fields}
+        self.events.append(ev)
+        return ev
+
+    def to_doc(self) -> dict:
+        return {
+            "format": FORMAT,
+            "version": VERSION,
+            "seed": self.seed,
+            "snapshot": self.snapshot,
+            "config": self.config,
+            "invariants": self.invariants,
+            "meta": self.meta,
+            "events": self.events,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Stream":
+        problems = validate_stream_doc(doc)
+        if problems:
+            raise ValueError("invalid twin stream: " + "; ".join(problems))
+        return cls(seed=int(doc.get("seed", 0)),
+                   snapshot=doc.get("snapshot"),
+                   config=doc.get("config"),
+                   events=list(doc.get("events", [])),
+                   invariants=list(doc.get("invariants", [])),
+                   meta=dict(doc.get("meta", {})))
+
+    def copy_with_events(self, events: list[dict]) -> "Stream":
+        """A new stream with the same header and the given events,
+        logical clocks renumbered (the minimizer's rebuild step)."""
+        out = Stream(seed=self.seed, snapshot=self.snapshot,
+                     config=self.config,
+                     invariants=list(self.invariants),
+                     meta=dict(self.meta))
+        for ev in events:
+            fields = {k: v for k, v in ev.items() if k not in ("op", "lc")}
+            out.append(ev["op"], **fields)
+        return out
+
+
+def validate_stream_doc(doc, require_invariants: bool = False) -> list[str]:
+    """Structural validity of a stream document — one message per
+    problem, empty when valid.  Pure (no package imports): the lint
+    gate runs this over every checked-in scenario stream."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["stream document must be a mapping"]
+    if doc.get("format") != FORMAT:
+        problems.append(f"format must be {FORMAT!r}, got "
+                        f"{doc.get('format')!r}")
+    if doc.get("version") != VERSION:
+        problems.append(f"unsupported stream version {doc.get('version')!r}"
+                        f" (expected {VERSION})")
+    if problems:
+        return problems  # wrong container: field checks would be noise
+    if not isinstance(doc.get("seed", 0), int):
+        problems.append("seed must be an integer")
+    snap = doc.get("snapshot")
+    if snap is not None and not isinstance(snap, dict):
+        problems.append("snapshot must be a mapping or null")
+    cfg = doc.get("config")
+    if cfg is not None and not isinstance(cfg, dict):
+        problems.append("config must be a mapping or null")
+    invs = doc.get("invariants", [])
+    if not isinstance(invs, list):
+        problems.append("invariants must be a list")
+        invs = []
+    for i, inv in enumerate(invs):
+        if not isinstance(inv, dict) or not inv.get("name"):
+            problems.append(f"invariants[{i}] must be a mapping with "
+                            f"a non-empty `name`")
+    if require_invariants and not invs:
+        problems.append("invariant set is empty — a checked-in scenario "
+                        "must pin at least one invariant")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        problems.append("events must be a list")
+        return problems
+    prev_lc = -1
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"events[{i}] must be a mapping")
+            continue
+        op = ev.get("op")
+        if op not in EVENT_OPS:
+            problems.append(f"events[{i}] has unknown op {op!r}")
+            continue
+        lc = ev.get("lc")
+        if not isinstance(lc, int) or lc <= prev_lc:
+            problems.append(f"events[{i}] logical clock {lc!r} does not "
+                            f"increase monotonically (prev {prev_lc})")
+        else:
+            prev_lc = lc
+        if op == "events":
+            batch = ev.get("events")
+            if not isinstance(batch, list) or not all(
+                    isinstance(e, (list, tuple)) and len(e) == 4
+                    for e in batch):
+                problems.append(f"events[{i}] batch must be a list of "
+                                f"[op, coll, key, payload] quadruples")
+        elif op == "delta":
+            if not isinstance(ev.get("delta"), dict):
+                problems.append(f"events[{i}] delta must be a mapping")
+        elif op == "tick":
+            if not isinstance(ev.get("seconds", None), (int, float)):
+                problems.append(f"events[{i}] tick needs numeric seconds")
+    return problems
+
+
+def write_stream(stream: Stream, path: str) -> None:
+    """Write a stream file (gzipped when the path ends ``.gz``)."""
+    data = json.dumps(stream.to_doc(), sort_keys=True).encode()
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(data)
+
+
+def read_doc(path: str):
+    """Read a JSON document (gzip by ``.gz``) WITHOUT validating it —
+    the format sniff ``snapshot_tool.py replay`` uses to route between
+    twin streams and classic cluster snapshots."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        return json.loads(f.read().decode())
+
+
+def read_stream(path: str) -> Stream:
+    """Read + validate a stream file; raises ``ValueError`` on a wrong
+    format/version or any structural problem."""
+    return Stream.from_doc(read_doc(path))
+
+
+class StreamRecorder:
+    """Thread-safe bounded recorder for a live cluster's applied
+    mutation stream.
+
+    Attach it with a snapshot of the cluster at recording start; the
+    shared applier (``intake/apply.apply_events``) mirrors every event
+    it successfully applied via ``Cluster.twin_recorder``, and the
+    server's stored-cycle handler records cycle boundaries.  When the
+    ring fills, NEW events are dropped (and counted) so the retained
+    prefix + header snapshot stay a valid replayable stream.
+    """
+
+    def __init__(self, limit: int = DEFAULT_EVENT_LIMIT):
+        self._lock = threading.Lock()
+        self._limit = int(limit)
+        # every field below is guarded by _lock (handler threads and
+        # the cycle thread both write through the public methods)
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._snapshot: dict | None = None
+        self._seed = 0
+        self._config: dict | None = None
+        self._attached = False
+
+    def __deepcopy__(self, memo):
+        # a deepcopied cluster (profiling twin, differential copy) must
+        # NOT re-record its own replay into the live recorder — the
+        # copy's twin_recorder hook drops to None
+        return None
+
+    def attach(self, snapshot: dict | None, seed: int = 0,
+               config: dict | None = None) -> None:
+        """(Re)start recording from this snapshot — resets the ring."""
+        with self._lock:
+            self._snapshot = snapshot
+            self._seed = int(seed)
+            self._config = config
+            self._events = []
+            self._dropped = 0
+            self._attached = True
+
+    def detach(self) -> None:
+        """Stop recording; the captured prefix stays readable."""
+        with self._lock:
+            self._attached = False
+
+    @property
+    def attached(self) -> bool:
+        return self._attached
+
+    def _append(self, op: str, fields: dict) -> None:
+        with self._lock:
+            if not self._attached:
+                return
+            if len(self._events) >= self._limit:
+                self._dropped += 1
+                return
+            lc = (self._events[-1]["lc"] + 1) if self._events else 0
+            self._events.append({"op": op, "lc": lc, **fields})
+
+    def record_events(self, applied: list[tuple]) -> None:
+        """One applied batch of ``(op, coll, key, payload)`` tuples —
+        called by the shared applier AFTER the events landed in the hub
+        journal.  Payload docs are deep-copied: callers may reuse or
+        mutate their delta documents after the apply returns."""
+        if not applied:
+            return
+        self._append("events", {
+            "events": [[op, coll, key, copy.deepcopy(payload)]
+                       for op, coll, key, payload in applied]})
+
+    def record_cycle(self) -> None:
+        self._append("cycle", {})
+
+    def record_tick(self, seconds: float) -> None:
+        self._append("tick", {"seconds": float(seconds)})
+
+    def record_reconcile(self) -> None:
+        self._append("reconcile", {})
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"recording": self._attached,
+                    "events": len(self._events),
+                    "dropped": self._dropped,
+                    "limit": self._limit}
+
+    def stream(self) -> Stream:
+        """The captured stream (a consistent copy)."""
+        with self._lock:
+            return Stream(seed=self._seed,
+                          snapshot=copy.deepcopy(self._snapshot),
+                          config=copy.deepcopy(self._config),
+                          events=copy.deepcopy(self._events),
+                          meta={"source": "recorder",
+                                "dropped": self._dropped})
+
+    def doc(self) -> dict:
+        return self.stream().to_doc()

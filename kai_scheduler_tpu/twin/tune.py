@@ -1,0 +1,307 @@
+"""kai-twin closed-loop policy autotuner.
+
+Random-search-with-elites over the scheduler's live knob surface,
+scored by replaying a recorded (or fuzz-generated) stream through the
+twin with each candidate overlaid on the stream's own config.  The
+objective is the kai-pulse composite: goodput up, fairness drift down,
+starvation age down, cycle p99 down — candidate metric rows are scored
+as one batched dot product (``jax.vmap`` when jax is importable, numpy
+otherwise; the scorer is a pure linear form so both are bit-identical).
+
+The winner is emitted as a ``conf.load_config``-loadable overlay
+document — drop it into the ConfigMap (or POST it to ``/config``) and
+the live scheduler runs the tuned policy.  The ``_twinTune`` key
+carries the score breakdown; ``load_config`` ignores unknown keys by
+design, so the provenance rides along harmlessly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import numpy as np
+
+try:  # the scorer vmaps on jax when present; numpy is bit-identical
+    import jax
+    import jax.numpy as jnp
+except Exception:  # noqa: BLE001 — jax-free envs score on numpy
+    jax = jnp = None
+
+from . import stream as stream_mod
+
+#: composite objective weights over the metric row
+#: (goodput_mean, drift_mean, starv_age_max, cycle_p99_seconds) —
+#: goodput dominates; the wall-clock term is a tie-breaker only, so
+#: measurement noise (and residual jax compiles — ``tune`` burns an
+#: unscored warmup rollout to keep them out of the scored rows) can
+#: never outvote a scheduling-quality difference
+WEIGHTS = (1.0, -0.5, -0.01, -0.002)
+
+#: metric row labels, index-aligned with WEIGHTS
+METRIC_NAMES = ("goodput_mean", "drift_mean", "starv_age_max",
+                "cycle_p99_s")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable config-document leaf.
+
+    ``path`` addresses the overlay doc (nested keys); ``kind`` is
+    ``int`` / ``float`` / ``choice``.  ``placementGpu`` is the one
+    special case — it renders as a ``tiers`` plugin-arguments doc
+    rather than a scalar leaf.
+    """
+
+    name: str
+    path: tuple[str, ...]
+    kind: str
+    lo: float = 0.0
+    hi: float = 1.0
+    choices: tuple = ()
+
+    def sample(self, rng: random.Random):
+        if self.kind == "choice":
+            return rng.choice(self.choices)
+        if self.kind == "int":
+            return rng.randint(int(self.lo), int(self.hi))
+        return round(rng.uniform(self.lo, self.hi), 4)
+
+    def mutate(self, value, rng: random.Random):
+        if self.kind == "choice":
+            return rng.choice(self.choices)
+        if self.kind == "int":
+            span = max(1, int((self.hi - self.lo) * 0.25))
+            v = int(value) + rng.randint(-span, span)
+            return int(min(self.hi, max(self.lo, v)))
+        span = (self.hi - self.lo) * 0.25
+        v = float(value) + rng.uniform(-span, span)
+        return round(min(self.hi, max(self.lo, v)), 4)
+
+
+KNOBS = (
+    Knob("kValue", ("kValue",), "float", 0.05, 1.0),
+    Knob("allocateDepth", ("queueDepthPerAction", "allocate"),
+         "int", 1, 32),
+    Knob("reclaimDepth", ("queueDepthPerAction", "reclaim"),
+         "int", 1, 16),
+    Knob("preemptDepth", ("queueDepthPerAction", "preempt"),
+         "int", 1, 16),
+    Knob("repackFragThreshold", ("repack", "fragThreshold"),
+         "float", 0.2, 0.9),
+    Knob("repackCooldown", ("repack", "cooldownCycles"), "int", 2, 16),
+    Knob("repackTrigger", ("repack", "triggerCycles"), "int", 1, 4),
+    Knob("analyticsEvery", ("analyticsEvery",), "int", 1, 4),
+    Knob("starvationAlarmCycles", ("starvationAlarmCycles",),
+         "int", 4, 64),
+    Knob("intakeLanes", ("intake", "lanes"), "int", 1, 8),
+    Knob("intakeLaneCapacity", ("intake", "laneCapacity"),
+         "int", 1024, 65536),
+    Knob("sparseUnitK", ("victims", "sparseUnitK"), "int", 64, 512),
+    Knob("maxVictimPods", ("victims", "maxVictimPods"),
+         "int", 64, 1024),
+    Knob("placementGpu", ("placementGpu",), "choice",
+         choices=("binpack", "spread")),
+)
+
+_KNOBS_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def to_overlay(candidate: dict) -> dict:
+    """A candidate (knob-name → value) as a conf-loadable document."""
+    doc: dict = {}
+    for name, value in candidate.items():
+        knob = _KNOBS_BY_NAME[name]
+        if name == "placementGpu":
+            doc["tiers"] = [{"plugins": [{
+                "name": "nodeplacement",
+                "arguments": {"gpu": value}}]}]
+            continue
+        node = doc
+        for key in knob.path[:-1]:
+            node = node.setdefault(key, {})
+        node[knob.path[-1]] = value
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# rollout + batched scoring
+# ---------------------------------------------------------------------------
+
+
+def _p99(xs: list[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def rollout(stream: stream_mod.Stream, candidate: dict,
+            base=None) -> list[float]:
+    """Replay the stream under one candidate overlay; return its
+    metric row (see :data:`METRIC_NAMES`)."""
+    from ..framework import metrics
+    from . import replay as replay_mod
+    goodput: list[float] = []
+    drift: list[float] = []
+    starv: list[float] = []
+    cycle_s: list[float] = []
+
+    def probe(cluster, result, digest):
+        acts = result.action_seconds
+        act_s = (sum(acts.values()) if isinstance(acts, dict)
+                 else float(acts or 0.0))
+        cycle_s.append(result.session_seconds + act_s)
+        a = result.analytics
+        if not a:
+            return
+        goodput.append(float(a["goodput"]))
+        drift.append(float(a["fairness"]["drift_mean"]))
+        ages = [o["age_cycles"] for o in a["starvation"]["oldest"]]
+        starv.append(float(max(ages, default=0)))
+
+    replay_mod.replay(stream, base=base, overlay=to_overlay(candidate),
+                      digest=False, on_cycle=probe)
+    metrics.twin_tuner_rollouts.inc()
+    mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
+    return [mean(goodput), mean(drift), max(starv, default=0.0),
+            _p99(cycle_s)]
+
+
+def score_rows(rows: list[list[float]]) -> list[float]:
+    """Batched composite scores — one vmapped dot product over the
+    candidate × metric matrix (numpy fallback is bit-identical: the
+    scorer is a pure linear form)."""
+    mat = np.asarray(rows, dtype=np.float32)
+    w = np.asarray(WEIGHTS, dtype=np.float32)
+    if jax is not None:
+        scores = jax.vmap(lambda r: jnp.dot(r, w))(jnp.asarray(mat))
+        return [float(s) for s in scores]
+    return [float(s) for s in mat @ w]
+
+
+# ---------------------------------------------------------------------------
+# the search loop
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TuneReport:
+    """The tuner's outcome: the winning overlay + full history."""
+
+    best_candidate: dict = dataclasses.field(default_factory=dict)
+    best_score: float = float("-inf")
+    best_metrics: list[float] = dataclasses.field(default_factory=list)
+    baseline_score: float = 0.0
+    baseline_metrics: list[float] = dataclasses.field(
+        default_factory=list)
+    rollouts: int = 0
+    history: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def improvement(self) -> float:
+        return self.best_score - self.baseline_score
+
+    def overlay_doc(self) -> dict:
+        """The conf-loadable winner, score breakdown riding along
+        under ``_twinTune`` (``load_config`` ignores unknown keys)."""
+        doc = to_overlay(self.best_candidate)
+        doc["_twinTune"] = {
+            "score": round(self.best_score, 6),
+            "baselineScore": round(self.baseline_score, 6),
+            "improvement": round(self.improvement, 6),
+            "metrics": {n: round(v, 6) for n, v in
+                        zip(METRIC_NAMES, self.best_metrics)},
+            "baselineMetrics": {n: round(v, 6) for n, v in
+                                zip(METRIC_NAMES,
+                                    self.baseline_metrics)},
+            "rollouts": self.rollouts,
+        }
+        return doc
+
+
+def _initial_population(rng: random.Random, size: int,
+                        knobs) -> list[dict]:
+    """Baseline + one axis probe per knob (hi then lo) + random fill.
+    The axis probes guarantee the sweep covers each knob's extremes
+    regardless of seed — a planted bad knob in the stream config is
+    always countered by some candidate."""
+    pop: list[dict] = [{}]  # the stream's own config, untouched
+    for knob in knobs:
+        if knob.kind == "choice":
+            for c in knob.choices:
+                pop.append({knob.name: c})
+        else:
+            hi = int(knob.hi) if knob.kind == "int" else knob.hi
+            lo = int(knob.lo) if knob.kind == "int" else knob.lo
+            pop.append({knob.name: hi})
+            pop.append({knob.name: lo})
+    while len(pop) < size:
+        pop.append({k.name: k.sample(rng)
+                    for k in knobs if rng.random() < 0.4})
+    return pop[:max(size, 1)]
+
+
+def tune(stream: stream_mod.Stream, rounds: int = 2,
+         population: int = 8, elites: int = 2, seed: int = 0,
+         base=None, knobs=None) -> TuneReport:
+    """Closed-loop search: evaluate a population of overlays against
+    the stream, keep the elites, mutate them into the next round.
+    Fully deterministic for a given (stream, seed, rounds,
+    population)."""
+    from ..framework import metrics
+    knobs = tuple(knobs if knobs is not None else KNOBS)
+    rng = random.Random(seed)
+    report = TuneReport()
+    # unscored warmup: the first replay pays every jax compile; its
+    # timings must not leak into any scored row (the p99 term would
+    # otherwise be compile noise, not steady-state cycle latency)
+    rollout(stream, {}, base=base)
+    report.baseline_metrics = rollout(stream, {}, base=base)
+    report.baseline_score = score_rows([report.baseline_metrics])[0]
+    report.rollouts = 1
+    report.best_score = report.baseline_score
+    report.best_metrics = list(report.baseline_metrics)
+    scored: list[tuple[float, dict, list[float]]] = [
+        (report.baseline_score, {}, report.baseline_metrics)]
+    pop = _initial_population(rng, population, knobs)
+    for rnd in range(rounds):
+        rows = [rollout(stream, cand, base=base) for cand in pop]
+        report.rollouts += len(pop)
+        for cand, row, score in zip(pop, rows, score_rows(rows)):
+            scored.append((score, cand, row))
+            report.history.append({"round": rnd, "candidate": cand,
+                                   "metrics": row, "score": score})
+        scored.sort(key=lambda t: t[0], reverse=True)
+        scored = scored[:max(elites, 1)]
+        # next round: mutate the elites, fill with fresh samples
+        pop = []
+        for _score, cand, _row in scored:
+            child = dict(cand)
+            for knob in knobs:
+                if rng.random() < 0.3:
+                    cur = child.get(knob.name, knob.sample(rng))
+                    child[knob.name] = knob.mutate(cur, rng)
+            pop.append(child)
+        while len(pop) < population:
+            pop.append({k.name: k.sample(rng)
+                        for k in knobs if rng.random() < 0.4})
+    best_score, best_cand, best_row = scored[0]
+    report.best_score = best_score
+    report.best_candidate = best_cand
+    report.best_metrics = best_row
+    metrics.twin_tuner_best_score.set(value=best_score)
+    return report
+
+
+if __name__ == "__main__":  # pragma: no cover - operator tool
+    import json
+    import sys
+    if len(sys.argv) < 2:
+        print("usage: python -m kai_scheduler_tpu.twin.tune "
+              "STREAM [ROUNDS [POP]]", file=sys.stderr)
+        raise SystemExit(2)
+    st = stream_mod.read_stream(sys.argv[1])
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    pop = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    rep = tune(st, rounds=rounds, population=pop)
+    print(json.dumps(rep.overlay_doc(), indent=2))

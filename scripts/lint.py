@@ -158,6 +158,44 @@ def check_metrics_doc() -> list[str]:
     return problems
 
 
+SCENARIO_STREAM_DIR = os.path.join(REPO_ROOT, "tests", "scenarios",
+                                   "streams")
+
+
+def check_scenario_streams(dirpath: str = SCENARIO_STREAM_DIR) -> list[str]:
+    """Validity gate over the checked-in kai-twin scenario streams,
+    jax-free (``twin/stream.py`` is stdlib-only by design): every
+    ``*.stream.json[.gz]`` must parse, carry the exact format/version,
+    pass structural validation, and declare a non-empty invariant set.
+    Regenerate with ``python -m kai_scheduler_tpu.twin.fuzz
+    --write-scenarios tests/scenarios/streams``."""
+    import json
+    from kai_scheduler_tpu.twin.stream import (read_doc,
+                                               validate_stream_doc)
+    if not os.path.isdir(dirpath):
+        return [f"{dirpath} is missing — the fuzzer's minimized "
+                f"scenarios must be checked in"]
+    files = sorted(f for f in os.listdir(dirpath)
+                   if f.endswith((".stream.json", ".stream.json.gz")))
+    if not files:
+        return [f"{dirpath} holds no *.stream.json files"]
+    problems = []
+    for fname in files:
+        path = os.path.join(dirpath, fname)
+        try:
+            doc = read_doc(path)
+        except (OSError, json.JSONDecodeError, ValueError) as exc:
+            problems.append(f"{fname}: unreadable ({exc})")
+            continue
+        for msg in validate_stream_doc(doc, require_invariants=True):
+            problems.append(f"{fname}: {msg}")
+    if problems:
+        problems.append("regenerate: python -m kai_scheduler_tpu."
+                        "twin.fuzz --write-scenarios "
+                        "tests/scenarios/streams")
+    return problems
+
+
 if __name__ == "__main__":
     rc = main(["--no-probe", "--root", REPO_ROOT, *sys.argv[1:]])
     drift = check_metrics_doc()
@@ -166,4 +204,7 @@ if __name__ == "__main__":
     cost_drift = check_cost_baseline()
     for msg in cost_drift:
         print(f"COST-BASELINE DRIFT: {msg}", file=sys.stderr)
-    sys.exit(rc or (1 if drift or cost_drift else 0))
+    stream_drift = check_scenario_streams()
+    for msg in stream_drift:
+        print(f"SCENARIO-STREAM DRIFT: {msg}", file=sys.stderr)
+    sys.exit(rc or (1 if drift or cost_drift or stream_drift else 0))

@@ -4,10 +4,20 @@
 Usage:
     python snapshot_tool.py dump OUT.json[.gz]        # synthetic demo dump
     python snapshot_tool.py replay SNAP.json[.gz]     # one cycle, print commits
+    python snapshot_tool.py replay STREAM.json[.gz]   # twin stream: oracle replay
+    python snapshot_tool.py record OUT --url BASE     # pull /debug/twin stream
+    python snapshot_tool.py record OUT --family F [--seed N] [--scale X]
 
-``replay`` loads a cluster snapshot, runs exactly one scheduling cycle
-against it with the default config, and prints the commit set (bind
-requests + evictions) as JSON lines — deterministic for a given file.
+``replay`` on a cluster snapshot loads it, runs exactly one scheduling
+cycle with the default config, and prints the commit set (bind requests
++ evictions) as JSON lines — deterministic for a given file.  On a
+kai-twin stream file (``format: kai-twin-stream``) it instead replays
+the whole stream through the differential oracle and prints the
+verdict; exit code 1 on any digest divergence.
+
+``record`` captures a stream: ``--url`` pulls the live recorder's
+stream from a running server's ``GET /debug/twin?stream=1``;
+``--family`` generates one synthetically from a fuzzer family.
 """
 from __future__ import annotations
 
@@ -27,7 +37,37 @@ def _dump(path: str) -> None:
     print(f"wrote synthetic snapshot to {path}")
 
 
-def _replay(path: str) -> None:
+def _replay_stream(path: str) -> int:
+    from kai_scheduler_tpu.twin import replay as twin_replay
+    from kai_scheduler_tpu.twin import stream as twin_stream
+
+    stream = twin_stream.read_stream(path)
+    verdict = twin_replay.oracle(stream)
+    print(json.dumps({
+        "kind": "TwinOracle", "ok": verdict["ok"],
+        "checks": verdict["checks"],
+        "divergences": len(verdict["divergences"]),
+        "events_applied": verdict["replay"]["events_applied"],
+        "cycles": verdict["replay"]["cycles"],
+    }, sort_keys=True))
+    for d in verdict["divergences"]:
+        print(json.dumps({"kind": "Divergence", "detail": d},
+                         sort_keys=True))
+    # throughput goes to stderr so stdout stays byte-identical
+    print(json.dumps({"events_per_s": verdict["replay"]["events_per_s"]}),
+          file=sys.stderr)
+    return 0 if verdict["ok"] else 1
+
+
+def _replay(path: str) -> int:
+    from kai_scheduler_tpu.twin import stream as twin_stream
+
+    # sniff the format field: a twin stream replays through the oracle,
+    # anything else stays the classic one-cycle snapshot replay
+    doc = twin_stream.read_doc(path)
+    if isinstance(doc, dict) and doc.get("format") == twin_stream.FORMAT:
+        return _replay_stream(path)
+
     from kai_scheduler_tpu.framework.scheduler import Scheduler
     from kai_scheduler_tpu.runtime.snapshot import load
 
@@ -55,14 +95,63 @@ def _replay(path: str) -> None:
     print(json.dumps({k: round(v, 4)
                       for k, v in result.action_seconds.items()}),
           file=sys.stderr)
+    return 0
+
+
+def _record(out: str, opts: dict) -> int:
+    from kai_scheduler_tpu.twin import stream as twin_stream
+
+    if opts.get("url"):
+        import urllib.request
+        with urllib.request.urlopen(
+                opts["url"].rstrip("/") + "/debug/twin?stream=1") as r:
+            doc = json.loads(r.read())
+        stream_doc = doc.get("stream")
+        if not stream_doc:
+            print("server has no recorded stream "
+                  "(twinRecord: false?)", file=sys.stderr)
+            return 1
+        stream = twin_stream.Stream.from_doc(stream_doc)
+    elif opts.get("family"):
+        from kai_scheduler_tpu.twin import fuzz
+        stream = fuzz.generate(opts["family"],
+                               seed=int(opts.get("seed", 0)),
+                               scale=float(opts.get("scale", 1.0)))
+    else:
+        print("record needs --url BASE or --family NAME",
+              file=sys.stderr)
+        return 2
+    twin_stream.write_stream(stream, out)
+    print(f"wrote twin stream ({len(stream.events)} events) to {out}")
+    return 0
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) != 3 or argv[1] not in ("dump", "replay"):
+    args = argv[1:]
+    if not args or args[0] not in ("dump", "replay", "record"):
         print(__doc__, file=sys.stderr)
         return 2
-    (_dump if argv[1] == "dump" else _replay)(argv[2])
-    return 0
+    cmd, args = args[0], args[1:]
+    if cmd in ("dump", "replay"):
+        if len(args) != 1:
+            print(__doc__, file=sys.stderr)
+            return 2
+        if cmd == "dump":
+            _dump(args[0])
+            return 0
+        return _replay(args[0])
+    # record OUT [--url BASE | --family NAME [--seed N] [--scale X]]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    out, opts = args[0], {}
+    it = iter(args[1:])
+    for flag in it:
+        if not flag.startswith("--"):
+            print(__doc__, file=sys.stderr)
+            return 2
+        opts[flag[2:]] = next(it, "")
+    return _record(out, opts)
 
 
 if __name__ == "__main__":
