@@ -473,6 +473,19 @@ def _cost_model_peak_mb(sched) -> float | None:
     return costmodel.peak_mb_for_state(state).get("fused_pipeline")
 
 
+def _comm_model_bytes_per_cycle(sched) -> int | None:
+    """kai-comms' modeled cross-device collective bytes for the fused
+    entry, traced at the scheduler's CURRENT snapshot shapes
+    (analysis/comms.py) — a pure re-trace over ShapeDtypeStructs, no
+    compile/dispatch; None when no snapshot has been built yet."""
+    from kai_scheduler_tpu.analysis import comms
+    snap = getattr(sched, "_snapshotter", None)
+    state = getattr(snap, "_dev", None) if snap is not None else None
+    if state is None:
+        return None
+    return comms.comm_bytes_for_state(state).get("fused_pipeline")
+
+
 def _churn_cluster(cluster, rng, frac: float,
                    num_nodes: int = 10_000) -> None:
     """Journaled churn (evict half / rebind half / tick) through the
@@ -678,6 +691,13 @@ def bench_phases(iters: int, *, num_nodes: int = 10_000,
         # test pins the model's traffic ranking against measured
         # dispatch ordering at canonical shapes)
         "cost_model_peak_mb": _cost_model_peak_mb(sched),
+        # kai-comms (analysis/comms.py): the fused entry's modeled
+        # collective bytes per cycle at this bench shape, priced for
+        # the 8-way virtual mesh — the next MULTICHIP artifact records
+        # this column beside the measured per-device wall time so the
+        # model's scaling fit can be checked against hardware
+        "comm_model_bytes_per_cycle": _comm_model_bytes_per_cycle(
+            sched),
         # kai-pulse rides every cycle here (analytics_every=1 default):
         # host dispatch cost of the analytics pass + the BENCH_r06+
         # cluster-health tracking columns from the last cycle

@@ -34,6 +34,16 @@ This package machine-checks those invariants in two layers:
   budgets in ``cost_baseline.json``, and a scaling mode that fits the
   peak-memory growth exponent over the node axis (the mesh-sharding
   go/no-go signal).
+* **Layer 5 — kai-comms** (``comms``): a static SPMD sharding &
+  collective-cost audit over the same shared walk — PartitionSpec
+  propagation seeded from ``parallel/mesh.state_shardings``, a ring
+  byte model per collective-inducing eqn (trip-count-charged under
+  loops), the ``KAI301`` node-axis-replication / ``KAI302``
+  declared-vs-inferred drift / ``KAI303`` collective-under-loop
+  checks, per-entry budgets in ``comm_baseline.json``, an HLO
+  lowering cross-validation on the virtual 8-device mesh, and a
+  scaling mode that fits modeled comm bytes against device count
+  (sublinear = the ROADMAP-2 "go" signal).
 
 CLI: ``python -m kai_scheduler_tpu.analysis`` (see ``__main__``).
 Suppression syntax: ``# kai-lint: disable=KAI001`` (own line → next

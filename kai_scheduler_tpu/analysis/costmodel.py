@@ -100,8 +100,10 @@ TRAFFIC_SLACK_BYTES = 16384
 SUPERLINEAR_EXPONENT = 1.15
 
 #: the KAI2xx catalog — program-level rules implemented here, listed
-#: jax-free in ``engine.PROGRAM_RULES`` (one source for --list-rules)
-COST_RULES = PROGRAM_RULES
+#: jax-free in ``engine.PROGRAM_RULES`` (one source for --list-rules;
+#: the KAI3xx slice belongs to layer 5, ``comms.py``)
+COST_RULES = {k: v for k, v in PROGRAM_RULES.items()
+              if k.startswith("KAI2")}
 
 
 @dataclasses.dataclass(frozen=True)

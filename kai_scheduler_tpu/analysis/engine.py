@@ -76,15 +76,26 @@ def rule(code: str, title: str, *, bad: str = "", good: str = ""):
 
 
 #: program-level (jaxpr) rule codes — the checks live in
-#: ``costmodel.py`` (layer 4, needs jax) but the catalog must stay
-#: jax-free for ``--list-rules`` and ``scripts/lint.py``; their
-#: fixtures are jax functions exercised by ``tests/test_costmodel.py``,
-#: not AST snippets, so they are NOT engine ``Rule`` entries
+#: ``costmodel.py`` (KAI2xx, layer 4) and ``comms.py`` (KAI3xx, layer
+#: 5), both needing jax, but the catalog must stay jax-free for
+#: ``--list-rules`` and ``scripts/lint.py``; their fixtures are jax
+#: functions exercised by ``tests/test_costmodel.py`` /
+#: ``tests/test_comms.py``, not AST snippets, so they are NOT engine
+#: ``Rule`` entries
 PROGRAM_RULES = {
     "KAI201": "intermediate aval exceeds blowup_factor × the entry's "
               "largest input (broadcast blowup, jaxpr-level)",
     "KAI202": "donated input leaf not aliased to any output in the "
               "compiled executable (ineffective donation, "
+              "jaxpr-level)",
+    "KAI301": "intermediate materializes the full node axis "
+              "REPLICATED on every device above the size threshold "
+              "(accidental node-axis replication, jaxpr-level)",
+    "KAI302": "declared mesh.state_shardings leaf disagrees with the "
+              "kai-comms inferred seed spec (sharding drift, "
+              "mesh-level, both directions)",
+    "KAI303": "collective inside scan/while charged trip-count × "
+              "exceeds the loop comm budget (collective-under-loop, "
               "jaxpr-level)",
 }
 

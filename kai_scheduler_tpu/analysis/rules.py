@@ -25,6 +25,18 @@ Code families (stable — suppressions and baselines reference them):
   (``tests/test_costmodel.py``), their findings ride the engine's
   count-based baseline rows (``cost_baseline.json``), and inline
   source suppressions do not apply.
+* ``KAI3xx``        kai-comms program-level family (``comms.py``,
+  catalog in ``engine.PROGRAM_RULES``): KAI301 accidental node-axis
+  replication — an intermediate materializing the full node axis
+  replicated on every device above the size threshold; KAI302
+  declared-vs-inferred sharding drift — a ``mesh.state_shardings``
+  leaf disagreeing with the auditor's seed registry, checked
+  leaf-exact both directions; KAI303 collective-under-loop — a
+  collective inside ``scan``/``while`` whose trip-count-charged bytes
+  exceed the loop comm budget.  Same program-level conventions as
+  KAI2xx: jax-function fixtures (``tests/test_comms.py``),
+  justification-required baseline rows (``comm_baseline.json``), no
+  inline source suppressions.
 
 "Jit region" is the transitive call graph grown from the package's
 ``jax.jit`` entry points (see ``callgraph.py``); host-only code is

@@ -17,6 +17,9 @@ gang, and running-pod tensors are replicated — they are tiny next to
 """
 from __future__ import annotations
 
+import os
+import re
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -25,6 +28,32 @@ from ..runtime import wire_ledger
 from ..state.cluster_state import ClusterState
 
 NODE_AXIS = "nodes"
+
+#: the ONE virtual CPU device count every multi-device consumer forces
+#: (tests/conftest.py, __graft_entry__'s dryrun, and the kai-comms
+#: lowering stage) — hoisted here so two callers in one process can
+#: never ask XLA for different counts
+VIRTUAL_DEVICE_COUNT = 8
+
+
+def ensure_virtual_cpu_devices(
+        n_devices: int = VIRTUAL_DEVICE_COUNT) -> None:
+    """Ask XLA for ``n_devices`` virtual CPU devices (no-op once the
+    CPU backend has initialised).  Rewrites an existing smaller count
+    rather than only appending, so an inherited flag can be repaired.
+    Pure env-var surgery: importing this module does NOT initialise
+    any jax backend, so callers (tests/conftest.py before its own
+    ``import jax``, ``__graft_entry__`` at import time, the kai-comms
+    lowering stage) may call it ahead of first backend use."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  flags)
+    if m is None:
+        flags = (flags + " --xla_force_host_platform_device_count="
+                 f"{n_devices}")
+    elif int(m.group(1)) < n_devices:
+        flags = flags[:m.start(1)] + str(n_devices) + flags[m.end(1):]
+    os.environ["XLA_FLAGS"] = flags.strip()
 
 
 def make_mesh(devices: list | None = None, axis: str = NODE_AXIS) -> Mesh:

@@ -20,13 +20,14 @@ with the committed ``docs/metrics/METRICS.md``.  Regenerate with::
 (``tests/test_metrics_catalog.py`` runs the same check against the
 LIVE registry, plus a meta-check that this AST extraction matches it.)
 
-It also drift-checks the **kai-cost baseline coverage** without
-importing jax: probe coverage and cost coverage ride ONE registry
-(``analysis/trace_probe._registry``), so ``baseline.json``'s ``probe``
-keys and ``cost_baseline.json``'s ``entries`` keys must be identical
-sets — a new jit entry baselined for the probe but missing a cost
-budget (or vice versa) fails here pre-commit, before the jax-heavy
-gate ever runs.  Refresh both in one invocation with::
+It also drift-checks the **kai-cost and kai-comms baseline coverage**
+without importing jax: probe, cost, and comms coverage ride ONE
+registry (``analysis/trace_probe._registry``), so ``baseline.json``'s
+``probe`` keys, ``cost_baseline.json``'s ``entries`` keys, and
+``comm_baseline.json``'s ``entries`` keys must be identical sets — a
+new jit entry baselined for the probe but missing a cost budget or a
+comm budget (or vice versa) fails here pre-commit, before the
+jax-heavy gate ever runs.  Refresh all three in one invocation with::
 
     python -m kai_scheduler_tpu.analysis --update-baseline
 
@@ -58,6 +59,8 @@ PROBE_BASELINE = os.path.join(REPO_ROOT, "kai_scheduler_tpu",
                               "analysis", "baseline.json")
 COST_BASELINE = os.path.join(REPO_ROOT, "kai_scheduler_tpu",
                              "analysis", "cost_baseline.json")
+COMM_BASELINE = os.path.join(REPO_ROOT, "kai_scheduler_tpu",
+                             "analysis", "comm_baseline.json")
 
 
 def check_cost_baseline(probe_path: str = PROBE_BASELINE,
@@ -88,6 +91,38 @@ def check_cost_baseline(probe_path: str = PROBE_BASELINE,
     if problems:
         problems.append("refresh both in one invocation: python -m "
                         "kai_scheduler_tpu.analysis --update-baseline")
+    return problems
+
+
+def check_comm_baseline(probe_path: str = PROBE_BASELINE,
+                        comm_path: str = COMM_BASELINE) -> list[str]:
+    """kai-comms coverage drift, jax-free: the comm baseline budgets
+    the same registry the probe baseline covers, so their key sets must
+    match exactly.  One message per divergence, empty when in sync."""
+    import json
+    if not os.path.exists(comm_path):
+        return [f"{comm_path} is missing — generate with `python -m "
+                f"kai_scheduler_tpu.analysis --comms --update-baseline`"]
+    if not os.path.exists(probe_path):
+        return [f"{probe_path} is missing — generate with `python -m "
+                f"kai_scheduler_tpu.analysis --probe --update-baseline`"]
+    with open(probe_path, encoding="utf-8") as f:
+        probe = set(json.load(f).get("probe", {}))
+    with open(comm_path, encoding="utf-8") as f:
+        comm = set(json.load(f).get("entries", {}))
+    problems = []
+    for name in sorted(probe - comm):
+        problems.append(
+            f"entry `{name}` has a probe baseline but no kai-comms "
+            f"budget in comm_baseline.json")
+    for name in sorted(comm - probe):
+        problems.append(
+            f"comm_baseline.json budgets `{name}` but the probe "
+            f"baseline has no such entry (stale?)")
+    if problems:
+        problems.append("refresh all baselines in one invocation: "
+                        "python -m kai_scheduler_tpu.analysis "
+                        "--update-baseline")
     return problems
 
 
@@ -204,7 +239,11 @@ if __name__ == "__main__":
     cost_drift = check_cost_baseline()
     for msg in cost_drift:
         print(f"COST-BASELINE DRIFT: {msg}", file=sys.stderr)
+    comm_drift = check_comm_baseline()
+    for msg in comm_drift:
+        print(f"COMM-BASELINE DRIFT: {msg}", file=sys.stderr)
     stream_drift = check_scenario_streams()
     for msg in stream_drift:
         print(f"SCENARIO-STREAM DRIFT: {msg}", file=sys.stderr)
-    sys.exit(rc or (1 if drift or cost_drift or stream_drift else 0))
+    sys.exit(rc or (1 if drift or cost_drift or comm_drift
+                    or stream_drift else 0))

@@ -15,11 +15,16 @@ import os
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-from __graft_entry__ import _ensure_cpu_device_count  # noqa: E402
+# the single source of the virtual-device count (shared with
+# __graft_entry__'s dryrun and the kai-comms lowering stage); importing
+# the mesh module does NOT initialise a jax backend
+from kai_scheduler_tpu.parallel.mesh import (  # noqa: E402
+    VIRTUAL_DEVICE_COUNT, ensure_virtual_cpu_devices)
 
-_ensure_cpu_device_count(8)
+ensure_virtual_cpu_devices()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -62,3 +67,15 @@ _session_mod.build_snapshot = _padded_build_snapshot
 # compile at full optimization, while the test shapes execute in
 # milliseconds either way.  Compile at -O0 for tests.
 jax.config.update("jax_disable_most_optimizations", True)
+
+
+@pytest.fixture(scope="session")
+def virtual_devices():
+    """The VIRTUAL_DEVICE_COUNT CPU devices every multi-device test
+    shares.  Skips (rather than fails) if the backend initialised
+    before the XLA flag landed — a harness problem, not a product one."""
+    devs = jax.devices("cpu")
+    if len(devs) < VIRTUAL_DEVICE_COUNT:
+        pytest.skip(f"need {VIRTUAL_DEVICE_COUNT} virtual CPU devices, "
+                    f"got {len(devs)} (backend initialised too early)")
+    return devs[:VIRTUAL_DEVICE_COUNT]

@@ -1,0 +1,439 @@
+"""kai-comms tests — sharding-propagation units, KAI3xx fixtures,
+production audit, baseline coverage, lowering cross-validation,
+scaling, CLI.
+
+Mirrors the guarantee structure of ``test_costmodel.py``:
+
+1. **Unit pins** — the PartitionSpec lattice, the ring byte model, and
+   the per-primitive propagation rules against hand-computed jaxprs
+   (the interpreter itself is under test, not just its outputs).
+2. **Rule fixtures** — KAI301/KAI302/KAI303 carry must-trigger and
+   must-not-trigger fixtures like every AST rule; both directions run.
+3. **Package invariants** — every registered entry audits with zero
+   conservative fallbacks and zero findings, the checked-in comm
+   baseline covers exactly the registry, the declared mesh layout
+   agrees leaf-exact with the inferred seeds (KAI302 both directions),
+   the compiled HLO's collectives fall inside the model's predicted
+   set on the 8-device virtual mesh, and modeled comm bytes grow
+   sublinearly with the mesh.
+"""
+import importlib.util
+import json
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kai_scheduler_tpu.analysis import comms
+from kai_scheduler_tpu.analysis import trace_probe as tp
+
+pytestmark = pytest.mark.core
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NODES = "nodes"  # the mesh axis name (mesh.NODE_AXIS)
+
+
+@pytest.fixture(scope="module")
+def comm_reports():
+    """One full audit for the module — a pure re-trace, no compiles."""
+    base = comms.load_comm_baseline()
+    reports = comms.run_comms()
+    return base, {r.name: r for r in reports}
+
+
+def _analyze(fn, args, seeds, **kw):
+    closed = jax.make_jaxpr(fn)(*args)
+    return comms.analyze_closed("unit", closed, seeds, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. lattice + byte-model unit pins
+
+def test_meet_is_agreement_toward_replicated():
+    a = comms.Spec((NODES, None))
+    b = comms.Spec((NODES, "model"))
+    assert comms._meet(a, a) == a
+    assert comms._meet(a, b) == comms.Spec((NODES, None))
+    assert comms._meet(a, comms.Spec((None, None))).sharded is False
+
+
+def test_dedupe_first_occurrence_wins():
+    assert comms._dedupe([NODES, NODES, None]) == \
+        comms.Spec((NODES, None, None))
+
+
+def test_collective_bytes_ring_model():
+    # gather/scatter families move b·(d-1)/d; all-reduce is 2×
+    assert comms.collective_bytes("all_gather", 800, 8) == 700
+    assert comms.collective_bytes("reduce_scatter", 800, 8) == 700
+    assert comms.collective_bytes("all_reduce", 800, 8) == 1400
+    # a 1-device "mesh" still prices as a 2-ring (never free)
+    assert comms.collective_bytes("all_gather", 800, 1) == 400
+
+
+def test_elementwise_keeps_node_axis_sharded():
+    """x*2+1 over a sharded node axis: zero collectives modeled."""
+    r = _analyze(lambda x: x * jnp.float32(2.0) + jnp.float32(1.0),
+                 (jnp.zeros((64, 8), jnp.float32),),
+                 [comms.Spec((NODES, None))])
+    assert r.collective_sites == 0
+    assert r.comm_bytes == 0
+    assert r.conservative_prims == {}
+
+
+def test_reduce_over_sharded_axis_is_all_reduce():
+    """sum over the sharded dim crosses devices: one all-reduce of the
+    OUTPUT bytes."""
+    r = _analyze(lambda x: jnp.sum(x, axis=0),
+                 (jnp.zeros((64, 8), jnp.float32),),
+                 [comms.Spec((NODES, None))])
+    assert r.kinds == ["all_reduce"]
+    assert r.collective_sites == 1
+    assert r.comm_bytes == comms.collective_bytes("all_reduce", 8 * 4, 8)
+
+
+def test_reduce_over_replicated_axis_is_free():
+    """sum over the OTHER dim stays device-local — and the result
+    keeps the node axis, so a following elementwise is free too."""
+    r = _analyze(lambda x: jnp.sum(x, axis=1) * jnp.float32(3.0),
+                 (jnp.zeros((64, 8), jnp.float32),),
+                 [comms.Spec((NODES, None))])
+    assert r.collective_sites == 0
+
+
+def test_dot_general_contracted_sharding_is_all_reduce():
+    """Contracting over a sharded dim = partial products per device +
+    one all-reduce of the result."""
+    def dot(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+    r = _analyze(dot, (jnp.zeros((16, 64), jnp.float32),
+                       jnp.zeros((64, 32), jnp.float32)),
+                 [comms.Spec((None, NODES)), comms.Spec((NODES, None))])
+    assert r.kinds == ["all_reduce"]
+    assert r.comm_bytes == comms.collective_bytes(
+        "all_reduce", 16 * 32 * 4, 8)
+
+
+def test_scan_multiplies_trip_count():
+    """A collective inside a 5-trip scan is charged 5×."""
+    x = jnp.zeros((64, 8), jnp.float32)
+
+    def looped(x):
+        def body(c, _):
+            return c + jnp.sum(x), None
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=5)
+        return out
+
+    r = _analyze(looped, (x,), [comms.Spec((NODES, None))])
+    assert r.collective_sites == 1
+    (site,) = r.sites
+    assert site.mult == 5
+    assert r.loop_comm_bytes == r.comm_bytes > 0
+
+
+def test_unknown_primitive_is_conservative_and_reported():
+    """An unmodeled primitive over a sharded input gathers it (upper
+    bound) and is COUNTED — never silently dropped."""
+    r = _analyze(lambda x: jnp.fft.fft(x).real,
+                 (jnp.zeros((64, 8), jnp.float32),),
+                 [comms.Spec((NODES, None))])
+    assert sum(r.conservative_prims.values()) >= 1
+    assert "all_gather" in r.kinds
+
+
+# ---------------------------------------------------------------------------
+# 2. rule fixtures — both directions, every KAI3xx rule
+
+@pytest.mark.parametrize("code", sorted(comms.COMM_RULES))
+def test_rule_fixture_triggers(code):
+    findings = comms.audit_fixture(code, "bad")
+    assert [f.code for f in findings] == [code]
+
+
+@pytest.mark.parametrize("code", sorted(comms.COMM_RULES))
+def test_rule_fixture_clean_direction(code):
+    assert comms.audit_fixture(code, "good") == []
+
+
+def test_comm_rules_family_is_exactly_kai3xx():
+    assert comms.COMM_RULES
+    assert all(c.startswith("KAI3") for c in comms.COMM_RULES)
+
+
+def test_audit_fixture_rejects_unknown_rule():
+    with pytest.raises(ValueError, match="unknown comm rule"):
+        comms.audit_fixture("KAI999")
+
+
+# ---------------------------------------------------------------------------
+# 3. seed registry
+
+def test_seed_state_specs_shard_node_axis_only():
+    state, _ = tp._canonical_env(now=1000.0)
+    seeds = comms.seed_state_specs(state)
+    assert seeds.nodes.valid.dims[0] == NODES
+    # the [X, N] tables carry the node axis SECOND
+    assert seeds.nodes.filter_masks.dims[:2] == (None, NODES)
+    assert seeds.nodes.soft_scores.dims[:2] == (None, NODES)
+    for leaf in jax.tree_util.tree_leaves(seeds.queues):
+        assert not leaf.sharded
+    for leaf in jax.tree_util.tree_leaves(seeds.gangs):
+        assert not leaf.sharded
+
+
+def test_seed_state_specs_rejects_unclassified_section(monkeypatch):
+    """A new ClusterState section must be classified before it can
+    ride the mesh — the guard is a hard error, not a silent
+    replicated default."""
+    state, _ = tp._canonical_env(now=1000.0)
+    monkeypatch.setattr(comms, "_STATE_SECTIONS",
+                        ("nodes", "queues", "gangs"))
+    with pytest.raises(ValueError, match="running"):
+        comms.seed_state_specs(state)
+
+
+def test_entry_seeds_line_up_with_jaxpr_invars():
+    """The seed flattening mirrors trace_entry's arg flattening —
+    leaf-for-leaf, including the k_value kwarg tail."""
+    env = tp._canonical_env(now=1000.0)
+    spec = {s.name: s for s in tp._registry()}["victims_preempt_sparse"]
+    (trace,) = tp.trace_entries(["victims_preempt_sparse"], env=env)
+    seeds = comms._entry_seed_specs(spec, env, trace.closed)
+    assert len(seeds) == len(trace.closed.jaxpr.invars)
+    assert any(s.sharded for s in seeds)
+
+
+# ---------------------------------------------------------------------------
+# 4. production invariants
+
+def test_every_registered_entry_audits_clean(comm_reports):
+    """Zero findings, zero conservative fallbacks, full coverage — the
+    acceptance bar: the interpreter models every primitive the
+    production entries actually use."""
+    _, reports = comm_reports
+    assert set(reports) == set(comms.registered_comm_entries())
+    for r in reports.values():
+        assert r.findings == [], r.name
+        assert r.conservative_prims == {}, r.name
+
+
+def test_fused_entries_model_collectives(comm_reports):
+    """The flagship fused entries really exercise the model: sharded
+    compute with all three collective families present."""
+    _, reports = comm_reports
+    for nm in comms.LOWERING_ENTRIES:
+        r = reports[nm]
+        assert r.comm_bytes > 0
+        assert "all_reduce" in r.kinds and "all_gather" in r.kinds
+        assert r.top_collectives[0]["total_bytes"] >= \
+            r.top_collectives[-1]["total_bytes"]
+
+
+def test_comm_baseline_matches_measurements(comm_reports):
+    base, reports = comm_reports
+    assert set(base["entries"]) == set(reports)
+    assert base.get("num_devices") == comms.DEFAULT_CONFIG.num_devices
+    assert comms.check_against_comm_baseline(
+        list(reports.values()), base) == []
+    # zero baselined KAI3xx rows ship with the audit (acceptance)
+    assert base.get("baselined", []) == []
+
+
+def test_declared_shardings_agree_with_seeds():
+    """KAI302 production direction: mesh.state_shardings and the
+    auditor's seed registry agree leaf-exact."""
+    assert comms.check_declared_shardings() == []
+
+
+def test_baseline_regression_and_coverage_messages(comm_reports):
+    base, reports = comm_reports
+    rep = reports["fused_pipeline"]
+    doctored = {"num_devices": rep.num_devices,
+                "entries": {"fused_pipeline": {
+                    "collective_sites": 1,
+                    "comm_bytes": 1,
+                    "loop_comm_bytes": 1},
+                    "ghost_entry": dict(
+                        base["entries"]["fused_pipeline"])}}
+    problems = comms.check_against_comm_baseline([rep], doctored)
+    assert any("collective sites" in p for p in problems)
+    assert any("comm bytes" in p for p in problems)
+    assert any("ghost_entry" in p and "stale" in p for p in problems)
+    # an entry with NO baseline row names the refresh command
+    problems = comms.check_against_comm_baseline(
+        [rep], {"num_devices": rep.num_devices, "entries": {}},
+        full_coverage=False)
+    assert any("--update-baseline" in p for p in problems)
+
+
+def test_baseline_device_count_mismatch_flagged(comm_reports):
+    base, reports = comm_reports
+    doctored = dict(base, num_devices=4)
+    problems = comms.check_against_comm_baseline(
+        list(reports.values()), doctored)
+    assert any("4 devices" in p for p in problems)
+
+
+def test_baselined_kai3xx_rows_require_justification(comm_reports):
+    base, reports = comm_reports
+    rep = reports["fused_pipeline"]
+    row = {"file": "jaxpr:fused_pipeline", "code": "KAI301", "count": 1}
+    doctored = dict(base, baselined=[dict(row)])
+    problems = comms.check_against_comm_baseline([rep], doctored,
+                                                 full_coverage=False)
+    assert any("justification" in p for p in problems)
+    justified = dict(base, baselined=[
+        dict(row, justification="measured harmless at this shape")])
+    problems = comms.check_against_comm_baseline([rep], justified,
+                                                 full_coverage=False)
+    assert not any("justification" in p for p in problems)
+
+
+def test_update_comm_baseline_merges_subset(tmp_path, comm_reports):
+    """An --ops subset refresh must not drop the other entries."""
+    base, reports = comm_reports
+    path = tmp_path / "comm_baseline.json"
+    path.write_text(json.dumps(base))
+    comms.update_comm_baseline([reports["cumsum_ds"]], str(path))
+    data = json.loads(path.read_text())
+    assert set(data["entries"]) == set(base["entries"])
+    assert data["baselined"] == base.get("baselined", [])
+
+
+# ---------------------------------------------------------------------------
+# 5. lowering cross-validation (HLO vs model)
+
+def test_lowering_check_verifies_small_entry(virtual_devices):
+    """Tier-1 smoke on the cheapest collective-bearing entry: the
+    compiled HLO's collectives fall inside the predicted set."""
+    (doc,) = comms.lowering_check(names=("set_fair_share",))
+    assert doc["verified"] is True, doc
+    assert doc["num_devices"] == len(virtual_devices)
+    assert set(doc["hlo"]) <= comms._allowed_hlo_kinds(
+        set(doc["predicted"]))
+
+
+@pytest.mark.slow
+def test_lowering_check_verifies_fused_entries(virtual_devices):
+    """The acceptance bar: both fused production entries compile with
+    real in_shardings on the 8-device mesh and every HLO collective is
+    explained by the model."""
+    docs = comms.lowering_check()
+    assert [d["entry"] for d in docs] == list(comms.LOWERING_ENTRIES)
+    for d in docs:
+        assert d["verified"] is True, d
+    assert comms.lowering_problems(docs) == []
+
+
+def test_lowering_check_rejects_unknown_entry():
+    with pytest.raises(ValueError, match="unknown entries"):
+        comms.lowering_check(names=("ghost",))
+
+
+def test_lowering_problems_gate_semantics():
+    ok = {"entry": "e", "num_devices": 8, "predicted": ["all_reduce"],
+          "hlo": ["all_reduce"], "unexplained": [], "verified": True}
+    assert comms.lowering_problems([ok]) == []
+    unexplained = dict(ok, unexplained=["collective_permute"],
+                       verified=False)
+    (p,) = comms.lowering_problems([unexplained])
+    assert "did not predict" in p
+    unverifiable = {"entry": "e", "num_devices": 8,
+                    "predicted": ["all_reduce"], "verified": False,
+                    "error": "no HLO introspection"}
+    (p,) = comms.lowering_problems([unverifiable])
+    assert "UNVERIFIABLE" in p
+
+
+def test_hlo_kind_extraction_and_decompositions():
+    text = ("%ar = f32[8] all-reduce(f32[8] %x)\n"
+            "%ag = f32[8] all-gather-start(f32[1] %y)\n")
+    assert comms._hlo_collective_kinds(text) == {"all_reduce",
+                                                 "all_gather"}
+    # a predicted all-reduce licenses its reduce-scatter + all-gather
+    # decomposition; a bare all_gather licenses only itself
+    assert comms._allowed_hlo_kinds({"all_reduce"}) == {
+        "all_reduce", "reduce_scatter", "all_gather"}
+    assert comms._allowed_hlo_kinds({"all_gather"}) == {"all_gather"}
+
+
+# ---------------------------------------------------------------------------
+# 6. scaling + bench hook
+
+def test_comm_scaling_is_sublinear(comm_reports):
+    """Ring collectives cost b·(d-1)/d — modeled comm plateaus with
+    mesh growth (the ROADMAP-2 "go" signal), it must not grow
+    linearly."""
+    _, reports = comm_reports
+    rep = comms.comm_scaling_report(reports=list(reports.values()))
+    assert rep["device_counts"] == [2, 4, 8]
+    for nm in comms.LOWERING_ENTRIES:
+        row = rep["entries"][nm]
+        assert row["sublinear"] is True
+        assert row["exponent"] < comms.SUBLINEAR_EXPONENT_BAR
+        assert row["comm_bytes"] == sorted(row["comm_bytes"])
+
+
+def test_comm_scaling_rejects_unknown_entries():
+    with pytest.raises(ValueError, match="unknown entries"):
+        comms.comm_scaling_report(names=("ghost",))
+
+
+def test_comm_bytes_for_state_matches_audit(comm_reports):
+    """The bench hook's abstract re-trace prices identically to the
+    concrete audit at the same shapes."""
+    _, reports = comm_reports
+    state, _ = tp._canonical_env(now=1000.0)
+    got = comms.comm_bytes_for_state(state)
+    assert got == {"fused_pipeline":
+                   reports["fused_pipeline"].comm_bytes}
+
+
+# ---------------------------------------------------------------------------
+# 7. CLI + lint-script drift check
+
+def test_cli_comms_subset_json(capsys):
+    """--comms with an --ops subset: reports + KAI302 drift check run,
+    the expensive lowering stage is skipped (no fused entry named)."""
+    from kai_scheduler_tpu.analysis.__main__ import main
+    rc = main(["--comms", "--ops", "set_fair_share,cumsum_ds",
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert {r["name"] for r in out["comms"]} == {"set_fair_share",
+                                                 "cumsum_ds"}
+    assert out["comms_problems"] == []
+    assert out["comms_findings"] == []
+    assert out["comms_lowering"] == []
+
+
+def test_lint_script_comm_baseline_drift_check(tmp_path):
+    """scripts/lint.py's jax-free stage: probe/comms baseline coverage
+    in sync == clean; a missing comm budget (or a stale one) is a
+    drift message naming --update-baseline."""
+    spec = importlib.util.spec_from_file_location(
+        "lint_script", os.path.join(ROOT, "scripts", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert lint.check_comm_baseline() == []
+    pkg = os.path.join(ROOT, "kai_scheduler_tpu", "analysis")
+    probe_tmp = tmp_path / "baseline.json"
+    comm_tmp = tmp_path / "comm_baseline.json"
+    shutil.copy(os.path.join(pkg, "baseline.json"), probe_tmp)
+    with open(os.path.join(pkg, "comm_baseline.json"),
+              encoding="utf-8") as f:
+        comm_data = json.load(f)
+    comm_data["entries"].pop("allocate")
+    comm_data["entries"]["ghost_entry"] = {"collective_sites": 0,
+                                           "comm_bytes": 0,
+                                           "loop_comm_bytes": 0}
+    comm_tmp.write_text(json.dumps(comm_data))
+    problems = lint.check_comm_baseline(str(probe_tmp), str(comm_tmp))
+    assert any("allocate" in p for p in problems)
+    assert any("ghost_entry" in p for p in problems)
+    assert any("--update-baseline" in p for p in problems)
+    assert lint.check_comm_baseline(
+        str(probe_tmp), str(tmp_path / "missing.json"))

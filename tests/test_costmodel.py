@@ -448,15 +448,16 @@ def test_cost_cli_json_section(capsys):
 
 
 @pytest.mark.parametrize("argv", [
-    ["--probe", "--scaling"],       # cost stage skipped
+    ["--probe", "--scaling"],       # cost AND comms stages skipped
     ["--no-probe", "--scaling"],
     ["--no-probe", "--select", "KAI201"],   # not an engine rule
+    ["--no-probe", "--select", "KAI301"],   # kai-comms: also jaxpr-level
 ])
 def test_cli_rejects_flags_the_selected_stages_would_ignore(argv):
-    """--scaling without the cost stage, or a KAI2xx code on the lint
-    --select path, must be an argparse error — never a clean exit that
-    silently dropped the requested check (the --race/--select
-    precedent)."""
+    """--scaling without a scaling-capable stage, or a KAI2xx/KAI3xx
+    code on the lint --select path, must be an argparse error — never a
+    clean exit that silently dropped the requested check (the
+    --race/--select precedent)."""
     from kai_scheduler_tpu.analysis.__main__ import main
     with pytest.raises(SystemExit) as exc:
         main(argv)
@@ -470,25 +471,34 @@ def test_list_rules_includes_cost_family(capsys):
     assert "KAI201" in out and "KAI202" in out
 
 
-def test_update_baseline_refreshes_both_in_one_invocation(
+def test_update_baseline_refreshes_all_in_one_invocation(
         tmp_path, monkeypatch, capsys):
     """The satellite contract: one default-mode ``--update-baseline``
-    invocation rewrites the probe stats AND the cost budgets."""
+    invocation rewrites the probe stats, the cost budgets, AND the
+    kai-comms collective budgets."""
+    from kai_scheduler_tpu.analysis import comms
     from kai_scheduler_tpu.analysis.__main__ import main
     pkg = os.path.join(ROOT, "kai_scheduler_tpu", "analysis")
     probe_tmp = tmp_path / "baseline.json"
     cost_tmp = tmp_path / "cost_baseline.json"
+    comm_tmp = tmp_path / "comm_baseline.json"
     with open(os.path.join(pkg, "baseline.json"),
               encoding="utf-8") as f:
         probe_data = json.load(f)
     with open(os.path.join(pkg, "cost_baseline.json"),
               encoding="utf-8") as f:
         cost_data = json.load(f)
+    with open(os.path.join(pkg, "comm_baseline.json"),
+              encoding="utf-8") as f:
+        comm_data = json.load(f)
     probe_data["probe"].pop("cumsum_ds")
     cost_data["entries"].pop("cumsum_ds")
+    comm_data["entries"].pop("cumsum_ds")
     probe_tmp.write_text(json.dumps(probe_data))
     cost_tmp.write_text(json.dumps(cost_data))
+    comm_tmp.write_text(json.dumps(comm_data))
     monkeypatch.setattr(cm, "COST_BASELINE_PATH", str(cost_tmp))
+    monkeypatch.setattr(comms, "COMM_BASELINE_PATH", str(comm_tmp))
     rc = main(["--root", ROOT, "--baseline", str(probe_tmp),
                "--ops", "cumsum_ds", "--update-baseline", "--json"])
     assert rc == 0
@@ -496,23 +506,29 @@ def test_update_baseline_refreshes_both_in_one_invocation(
         probe_tmp.read_text())["probe"]
     assert "cumsum_ds" in json.loads(
         cost_tmp.read_text())["entries"]
+    assert "cumsum_ds" in json.loads(
+        comm_tmp.read_text())["entries"]
 
 
 def test_update_baseline_is_joint_or_nothing(tmp_path, monkeypatch):
-    """A probe-invariant failure holds BOTH baselines back: the cost
-    stats are not absorbed while baseline.json stays stale (a
-    half-refresh would tolerate cost growth caused by the very change
-    the probe blocked on)."""
-    from kai_scheduler_tpu.analysis import trace_probe
+    """A probe-invariant failure holds ALL baselines back: neither the
+    cost stats nor the comm budgets are absorbed while baseline.json
+    stays stale (a half-refresh would tolerate growth caused by the
+    very change the probe blocked on)."""
+    from kai_scheduler_tpu.analysis import comms, trace_probe
     from kai_scheduler_tpu.analysis.__main__ import main
     pkg = os.path.join(ROOT, "kai_scheduler_tpu", "analysis")
     probe_tmp = tmp_path / "baseline.json"
     cost_tmp = tmp_path / "cost_baseline.json"
+    comm_tmp = tmp_path / "comm_baseline.json"
     shutil.copy(os.path.join(pkg, "baseline.json"), probe_tmp)
     shutil.copy(os.path.join(pkg, "cost_baseline.json"), cost_tmp)
+    shutil.copy(os.path.join(pkg, "comm_baseline.json"), comm_tmp)
     probe_before = probe_tmp.read_text()
     cost_before = cost_tmp.read_text()
+    comm_before = comm_tmp.read_text()
     monkeypatch.setattr(cm, "COST_BASELINE_PATH", str(cost_tmp))
+    monkeypatch.setattr(comms, "COMM_BASELINE_PATH", str(comm_tmp))
     monkeypatch.setattr(trace_probe, "check_invariants",
                         lambda reports: ["synthetic invariant failure"])
     rc = main(["--root", ROOT, "--baseline", str(probe_tmp),
@@ -520,6 +536,7 @@ def test_update_baseline_is_joint_or_nothing(tmp_path, monkeypatch):
     assert rc == 1
     assert probe_tmp.read_text() == probe_before
     assert cost_tmp.read_text() == cost_before
+    assert comm_tmp.read_text() == comm_before
 
 
 def _load_lint_script():

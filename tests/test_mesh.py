@@ -1,4 +1,4 @@
-"""Multi-device mesh sharding tests (8 virtual CPU devices via conftest).
+"""Multi-device mesh sharding tests (virtual CPU devices via conftest).
 
 Validates SURVEY.md §2.9: the node axis of the cluster tensors shards
 over a ``jax.sharding.Mesh`` and the full scheduling step produces
@@ -6,6 +6,11 @@ placements identical to the unsharded run — the sharded kernels are a
 pure layout change, not a semantic one.  Reuses the cycle/state builders
 from ``__graft_entry__`` so the tested path is exactly the one the
 driver dry-runs.
+
+Also pins ``state_shardings`` against the kai-comms seed registry in
+BOTH directions (meta-test): the auditor's inferred seed specs are only
+trustworthy while they agree leaf-for-leaf with the layout the mesh
+module actually declares.
 """
 import jax
 import jax.numpy as jnp
@@ -14,18 +19,11 @@ import pytest
 
 import __graft_entry__ as ge
 from kai_scheduler_tpu.parallel import make_mesh, shard_state, state_shardings
+from kai_scheduler_tpu.parallel.mesh import VIRTUAL_DEVICE_COUNT
 
 
-@pytest.fixture(scope="module")
-def eight_devices():
-    devs = jax.devices()
-    if len(devs) < 8:
-        pytest.skip("needs 8 virtual devices (conftest XLA_FLAGS)")
-    return devs[:8]
-
-
-def test_sharded_cycle_matches_unsharded(eight_devices):
-    mesh = make_mesh(eight_devices)
+def test_sharded_cycle_matches_unsharded(virtual_devices):
+    mesh = make_mesh(virtual_devices)
     state = ge._make_state(num_nodes=24, num_gangs=12, tasks_per_gang=2,
                            pad=8)
     cycle = ge._cycle_fn()
@@ -45,8 +43,8 @@ def test_sharded_cycle_matches_unsharded(eight_devices):
     assert bool(jnp.any(allocated))
 
 
-def test_shard_state_places_node_axis(eight_devices):
-    mesh = make_mesh(eight_devices)
+def test_shard_state_places_node_axis(virtual_devices):
+    mesh = make_mesh(virtual_devices)
     state = ge._make_state(num_nodes=24, num_gangs=4, tasks_per_gang=2,
                            pad=8)
     sharded = shard_state(state, mesh)
@@ -58,8 +56,8 @@ def test_shard_state_places_node_axis(eight_devices):
     assert sharded.gangs.task_req.sharding.is_fully_replicated
 
 
-def test_shard_state_rejects_indivisible_axis(eight_devices):
-    mesh = make_mesh(eight_devices)
+def test_shard_state_rejects_indivisible_axis(virtual_devices):
+    mesh = make_mesh(virtual_devices)
     # 20 nodes with pad=4 stays 20 — not divisible by the 8-way mesh
     state = ge._make_state(num_nodes=20, num_gangs=4, tasks_per_gang=2,
                            pad=4)
@@ -68,5 +66,44 @@ def test_shard_state_rejects_indivisible_axis(eight_devices):
         shard_state(state, mesh)
 
 
-def test_dryrun_multichip_entrypoint(eight_devices):
-    ge.dryrun_multichip(8)
+def test_dryrun_multichip_entrypoint(virtual_devices):
+    ge.dryrun_multichip(VIRTUAL_DEVICE_COUNT)
+
+
+def test_state_shardings_pins_comms_seed_registry(virtual_devices):
+    """Meta-test: mesh.state_shardings and comms.seed_state_specs agree
+    leaf-for-leaf, both directions.  A new NodeState field with the node
+    axis somewhere other than dim 0 must be registered in BOTH modules
+    (NODE_AXIS_SECOND in comms.py, the replace() in state_shardings) —
+    this test is the tripwire."""
+    from kai_scheduler_tpu.analysis import comms
+
+    mesh = make_mesh(virtual_devices)
+    state = ge._make_state(num_nodes=24, num_gangs=4, tasks_per_gang=2,
+                           pad=8)
+
+    declared = state_shardings(state, mesh)
+    seeds = comms.seed_state_specs(state)
+
+    decl_leaves, decl_tree = jax.tree_util.tree_flatten_with_path(declared)
+    seed_leaves, seed_tree = jax.tree_util.tree_flatten_with_path(seeds)
+    # direction 1: same pytree structure — a leaf present in one view
+    # but not the other is itself drift
+    assert decl_tree == seed_tree
+    arr_leaves = jax.tree_util.tree_leaves(state)
+    assert len(arr_leaves) == len(decl_leaves)
+
+    for (path, sharding), (_, seed), arr in zip(
+            decl_leaves, seed_leaves, arr_leaves):
+        ndim = np.ndim(arr)
+        spec = sharding.spec
+        decl_dims = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+        decl_dims = tuple(d[0] if isinstance(d, tuple) else d
+                          for d in decl_dims)
+        # direction 2: per-leaf exact equality of the partition dims
+        assert decl_dims == seed.dims, (
+            f"{jax.tree_util.keystr(path)}: declared {decl_dims} "
+            f"!= inferred seed {seed.dims}")
+
+    # and the full-state KAI302 check (what the CLI runs) agrees: clean
+    assert comms.check_declared_shardings(state, mesh=mesh) == []
